@@ -1,0 +1,42 @@
+"""tpulab — a TPU-native inference-serving laboratory.
+
+A from-scratch rebuild of the capability set of NVIDIA/tensorrt-laboratory
+(``trtlab``) designed for TPU hardware: JAX/XLA/Pallas for the compute path,
+``jax.sharding`` meshes for multi-chip scale-out, and a native (C++) runtime core
+for the host-side memory/concurrency machinery.
+
+Layer map (mirrors reference trtlab/CMakeLists.txt:2-19 layering):
+
+    tpulab.memory    allocator framework (descriptors, arenas, transactional)
+    tpulab.core      host runtime (pools, thread pools, batcher, affinity)
+    tpulab.tpu       device layer (topology, sync, host<->HBM staging)
+    tpulab.engine    executable runtime (Runtime/Model/InferenceManager/...)
+    tpulab.rpc       async gRPC microservice framework
+    tpulab.models    model zoo (ResNet, MNIST, transformer) in pure JAX
+    tpulab.ops       Pallas kernels + attention ops
+    tpulab.parallel  mesh/sharding, DP dispatch, ring attention
+    tpulab.utils     flags, metrics, logging
+
+Top-level serving API (mirrors the reference pybind module surface,
+reference trtlab/pybind/trtlab/infer.cc:683-735)::
+
+    manager = tpulab.InferenceManager(max_exec_concurrency=4)
+    manager.register_model("rn50", model)        # or register_engine(path)
+    manager.update_resources()
+    runner = manager.infer_runner("rn50")
+    fut = runner.infer(input=np.zeros((1, 224, 224, 3), np.float32))
+    outputs = fut.get()
+    manager.serve(port=50051)                    # TRTIS-style gRPC service
+"""
+
+__version__ = "0.1.0"
+
+_API_NAMES = ("InferenceManager", "RemoteInferenceManager", "serve")
+
+
+def __getattr__(name):
+    # Lazy so `import tpulab.memory` doesn't pull in jax/grpc.
+    if name in _API_NAMES:
+        from tpulab import _api
+        return getattr(_api, name)
+    raise AttributeError(f"module 'tpulab' has no attribute {name!r}")

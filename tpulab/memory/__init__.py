@@ -1,0 +1,89 @@
+"""tpulab.memory — the allocator framework.
+
+A device-typed, descriptor-based memory framework with the capability set of
+``trtlab/memory`` (reference include/trtlab/memory/*.h, ~12.6k LoC C++):
+
+- compile-time-style memory *kinds* carrying a DLPack device type and alignment
+  policy (reference memory_type.h:93-129)
+- move-only owning ``Descriptor`` handles that release to their allocator on
+  destruction (reference descriptor.h:40-99)
+- a type-erased ``IAllocator`` interface + ``make_allocator`` facade adding
+  thread safety and tracking (reference allocator.h:41-290)
+- raw allocators (mmap/malloc, aligned, transparent huge pages), composable
+  block allocators, caching block arenas, a block manager for address->block
+  lookup (reference block_allocators.h, block_arena.h, block_manager.h)
+- a fixed-node ``MemoryPool`` free-list (reference memory_pool.h:37-295)
+- the serving-critical ``TransactionalAllocator`` — rotating ref-counted bump
+  stacks for per-request tensor scratch (reference transactional_allocator.h:155-367)
+- a best-fit ``BFitAllocator`` for long-lived variable-size allocations such as
+  weights (reference bfit_allocator.h:20-123)
+- allocation trackers and leak-checking/raii wrappers
+  (reference trackers.h, tracking.h, raii_allocator.h)
+
+The framework is device-agnostic over ``MemoryType``: the TPU build adds
+``TpuMemory`` (HBM via JAX/PjRt buffers) and ``HostPinnedMemory`` (staging) in
+:mod:`tpulab.tpu` without changing any allocator logic — exactly how the
+reference layers ``trtlab/cuda`` memory types onto ``trtlab/memory``.
+
+A C++17 implementation of the hot allocators (arena, transactional, pool) lives
+in ``cpp/`` and is used transparently when built (see tpulab.memory.native).
+"""
+
+from tpulab.memory.literals import KiB, MiB, GiB, bytes_to_string, string_to_bytes
+from tpulab.memory.memory_type import (
+    MemoryType,
+    HostMemory,
+    AnyMemory,
+    DLDeviceType,
+    is_memory_type,
+    is_host_accessible,
+)
+from tpulab.memory.descriptor import Descriptor, IAllocator
+from tpulab.memory.raw_allocators import (
+    MallocAllocator,
+    AlignedAllocator,
+    HugePageAllocator,
+)
+from tpulab.memory.block import (
+    MemoryBlock,
+    SingleBlockAllocator,
+    FixedSizeBlockAllocator,
+    GrowingBlockAllocator,
+    CountLimitedBlockAllocator,
+    SizeLimitedBlockAllocator,
+    is_block_allocator,
+)
+from tpulab.memory.arena import BlockArena, BlockStack, BlockManager
+from tpulab.memory.allocator import make_allocator, AllocatorImpl
+from tpulab.memory.memory_pool import MemoryPool
+from tpulab.memory.transactional import TransactionalAllocator, make_transactional_allocator
+from tpulab.memory.bfit import BFitAllocator
+from tpulab.memory.trackers import SizeTracker, TrackedBlockAllocator
+from tpulab.memory.raii import RaiiAllocator
+from tpulab.memory.debugging import (
+    set_leak_handler,
+    get_leak_handler,
+    OutOfMemory,
+    BadAllocationSize,
+    LeakError,
+)
+
+__all__ = [
+    "KiB", "MiB", "GiB", "bytes_to_string", "string_to_bytes",
+    "MemoryType", "HostMemory", "AnyMemory", "DLDeviceType",
+    "is_memory_type", "is_host_accessible",
+    "Descriptor", "IAllocator",
+    "MallocAllocator", "AlignedAllocator", "HugePageAllocator",
+    "MemoryBlock", "SingleBlockAllocator", "FixedSizeBlockAllocator",
+    "GrowingBlockAllocator", "CountLimitedBlockAllocator",
+    "SizeLimitedBlockAllocator", "is_block_allocator",
+    "BlockArena", "BlockStack", "BlockManager",
+    "make_allocator", "AllocatorImpl",
+    "MemoryPool",
+    "TransactionalAllocator", "make_transactional_allocator",
+    "BFitAllocator",
+    "SizeTracker", "TrackedBlockAllocator",
+    "RaiiAllocator",
+    "set_leak_handler", "get_leak_handler",
+    "OutOfMemory", "BadAllocationSize", "LeakError",
+]
