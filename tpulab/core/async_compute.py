@@ -1,0 +1,37 @@
+"""Promise-fulfilling callable wrappers (reference async_compute.h:38-118).
+
+``async_compute(fn)`` returns a :class:`SharedPackagedTask`: a callable whose
+invocation runs ``fn`` and fulfills a shared future with its result — the glue
+the reference uses between pipeline stages and RPC client completions.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Callable, Generic, TypeVar
+
+R = TypeVar("R")
+
+
+class SharedPackagedTask(Generic[R]):
+    """Callable binding a user fn to a promise (reference shared_packaged_task)."""
+
+    def __init__(self, fn: Callable[..., R]):
+        self._fn = fn
+        self._future: Future = Future()
+
+    def get_future(self) -> Future:
+        return self._future
+
+    def __call__(self, *args, **kwargs) -> None:
+        if self._future.done():
+            raise RuntimeError("SharedPackagedTask already invoked")
+        try:
+            self._future.set_result(self._fn(*args, **kwargs))
+        except BaseException as e:  # noqa: BLE001 - promise semantics
+            self._future.set_exception(e)
+
+
+def async_compute(fn: Callable[..., R]) -> SharedPackagedTask[R]:
+    """Reference ``async_compute<void(Args...)>::wrap(f)``."""
+    return SharedPackagedTask(fn)

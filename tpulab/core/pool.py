@@ -1,0 +1,203 @@
+"""Blocking resource pools with return-to-pool handles.
+
+The reference iterated four pool designs (reference pool.h:51-775); this is
+the v4 surface (``pop_shared``/``pop_unique``, ``UniquePool``) with the v1
+deleter trick (pool.h:192-204) expressed as a context-manager/finalizer handle:
+popping returns a ``PoolItem`` whose close/GC returns the resource to the pool,
+keeping the pool alive via a strong reference.  ``Pool.pop()`` blocks when
+empty — this is the backpressure mechanism the InferenceManager builds on
+(reference inference_manager.cc:232-273).
+
+``pop_async()`` is the fiber-policy variant (usable from event-loop handlers,
+the FiberExecutor path) — it awaits without blocking the OS thread.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import weakref
+from concurrent.futures import Future
+from typing import Any, Callable, Generic, Iterable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class Queue(Generic[T]):
+    """Mutex+CV blocking FIFO (reference pool.h Queue<T>:51-120)."""
+
+    def __init__(self):
+        self._items: collections.deque[T] = collections.deque()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def push(self, item: T) -> None:
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()
+
+    def pop(self, timeout: Optional[float] = None) -> T:
+        with self._cv:
+            if not self._cv.wait_for(lambda: len(self._items) > 0, timeout):
+                raise TimeoutError("Queue.pop timed out")
+            return self._items.popleft()
+
+    def try_pop(self) -> Optional[T]:
+        with self._cv:
+            return self._items.popleft() if self._items else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def empty(self) -> bool:
+        return len(self) == 0
+
+
+class PoolItem(Generic[T]):
+    """RAII handle: returns the resource on close/GC (reference v1 deleter
+    trick pool.h:192-204 / v3 Resource wrapper pool.h:356-452)."""
+
+    __slots__ = ("_value", "_returned", "_finalizer", "__weakref__")
+
+    def __init__(self, value: T, return_fn: Callable[[T], None]):
+        self._value = value
+        self._returned = False
+        self._finalizer = weakref.finalize(self, return_fn, value)
+
+    def get(self) -> T:
+        if self._returned:
+            raise RuntimeError("pool item already returned")
+        return self._value
+
+    #: dereference sugar: item.value
+    @property
+    def value(self) -> T:
+        return self.get()
+
+    def release(self) -> None:
+        """Return the resource to the pool now."""
+        if not self._returned:
+            self._returned = True
+            self._finalizer()
+
+    close = release
+
+    def detach(self) -> T:
+        """Take the resource out of pool management permanently."""
+        if self._returned:
+            raise RuntimeError("pool item already returned")
+        self._returned = True
+        self._finalizer.detach()
+        return self._value
+
+    def __enter__(self) -> T:
+        return self.get()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class Pool(Generic[T]):
+    """Shared resource pool (reference v4::Pool pool.h:454-638).
+
+    - ``push(item)`` adds a resource
+    - ``pop()`` blocks until available, returns a :class:`PoolItem`
+    - ``pop_async()`` awaitable variant for event-loop (fiber) handlers
+    - ``on_return`` hook runs as the item re-enters the pool (Reset semantics)
+    """
+
+    def __init__(self, items: Iterable[T] = (),
+                 on_return: Optional[Callable[[T], None]] = None):
+        self._queue: Queue[T] = Queue()
+        self._on_return = on_return
+        self._waiters: collections.deque = collections.deque()
+        self._waiter_lock = threading.Lock()
+        self._size = 0
+        for it in items:
+            self.push(it)
+
+    @classmethod
+    def create(cls, *args, **kwargs) -> "Pool[T]":
+        return cls(*args, **kwargs)
+
+    @property
+    def size(self) -> int:
+        """Total resources owned (in pool + checked out)."""
+        return self._size
+
+    @property
+    def available(self) -> int:
+        return len(self._queue)
+
+    def push(self, item: T) -> None:
+        self._size += 1
+        self._return(item, run_hook=False)
+
+    def _return(self, item: T, run_hook: bool = True) -> None:
+        if run_hook and self._on_return is not None:
+            self._on_return(item)
+        # Hand directly to an async waiter if any, else queue.  The push must
+        # happen under the waiter lock: pop_async registers waiters under the
+        # same lock after re-checking the queue, so serializing check+push
+        # here closes the lost-wakeup window.
+        with self._waiter_lock:
+            while self._waiters:
+                fut, loop = self._waiters.popleft()
+                if not fut.done():
+                    loop.call_soon_threadsafe(self._deliver, fut, item)
+                    return
+            self._queue.push(item)
+
+    def _deliver(self, fut, item: T) -> None:
+        # Runs on the waiter's loop. If the waiter was cancelled in the
+        # meantime, the resource must not be lost — return it properly so
+        # another waiter (or the queue) gets it.
+        if fut.done():
+            self._return(item, run_hook=False)
+        else:
+            fut.set_result(item)
+
+    def pop(self, timeout: Optional[float] = None,
+            on_return: Optional[Callable[[T], None]] = None) -> PoolItem[T]:
+        """Blocking pop (reference pop_shared). MAY BLOCK — backpressure point."""
+        value = self._queue.pop(timeout)
+        extra = on_return
+
+        def return_fn(v: T) -> None:
+            if extra is not None:
+                extra(v)
+            self._return(v)
+
+        return PoolItem(value, return_fn)
+
+    async def pop_async(self) -> PoolItem[T]:
+        """Event-loop pop (the fiber-policy specialization)."""
+        import asyncio
+        value = self._queue.try_pop()
+        if value is None:
+            loop = asyncio.get_running_loop()
+            fut: asyncio.Future = loop.create_future()
+            with self._waiter_lock:
+                # re-check under lock to avoid a lost wakeup
+                value = self._queue.try_pop()
+                if value is None:
+                    self._waiters.append((fut, loop))
+            if value is None:
+                value = await fut
+        return PoolItem(value, self._return)
+
+    def try_pop(self) -> Optional[PoolItem[T]]:
+        value = self._queue.try_pop()
+        if value is None:
+            return None
+        return PoolItem(value, self._return)
+
+
+class UniquePool(Pool[T]):
+    """Pool whose items are exclusively owned while out
+    (reference v4::UniquePool pool.h:640-775).  In Python exclusivity is by
+    convention — ``pop_unique`` returns the same RAII handle but ``detach`` is
+    the supported way to take ownership out."""
+
+    pop_unique = Pool.pop

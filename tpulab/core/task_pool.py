@@ -1,0 +1,73 @@
+"""Single-thread deadline scheduler (reference task_pool.h:36-113).
+
+A map of deadline -> tasks serviced by one thread doing ``cv.wait_until`` on
+the earliest deadline — used by the Dispatcher for batching-window timeouts.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Callable, Optional
+
+
+class DeferredShortTaskPool:
+    """Deadline-ordered task runner (reference DeferredShortTaskPool).
+
+    Tasks must be short: they run on the scheduler thread.
+    """
+
+    def __init__(self, name: str = "deferred"):
+        self._heap: list = []  # (deadline, seq, fn)
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def enqueue_deferred(self, delay_s: float, fn: Callable[[], None]) -> None:
+        """Run ``fn`` after ``delay_s`` seconds (reference enqueue_deferred)."""
+        self.enqueue_at(time.monotonic() + max(0.0, delay_s), fn)
+
+    def enqueue_at(self, deadline: float, fn: Callable[[], None]) -> None:
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("enqueue on stopped DeferredShortTaskPool")
+            heapq.heappush(self._heap, (deadline, next(self._seq), fn))
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._shutdown and not self._heap:
+                    self._cv.wait()
+                if self._shutdown and not self._heap:
+                    return
+                deadline, _seq, fn = self._heap[0]
+                now = time.monotonic()
+                if deadline > now:
+                    self._cv.wait(timeout=deadline - now)
+                    continue
+                heapq.heappop(self._heap)
+            try:
+                fn()
+            except Exception:  # pragma: no cover - keep scheduler alive
+                import logging
+                logging.getLogger("tpulab.core").exception("deferred task failed")
+
+    def shutdown(self, drain: bool = False) -> None:
+        with self._cv:
+            self._shutdown = True
+            if not drain:
+                self._heap.clear()
+            self._cv.notify()
+        self._thread.join(timeout=10)
+
+    def __enter__(self) -> "DeferredShortTaskPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

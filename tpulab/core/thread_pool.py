@@ -1,0 +1,99 @@
+"""Work-queue thread pool with CPU-affinity constructors
+(reference thread_pool.h:73-298; affinity ctors 94-116, CreateThread 255-274).
+
+Three construction modes, as in the reference:
+- ``ThreadPool(n)`` — N workers, no pinning
+- ``ThreadPool(n, cpus=CpuSet)`` — N workers all sharing one affinity mask
+- ``ThreadPool.one_per_cpu(cpus)`` — one worker pinned to each CPU in the set
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+from tpulab.core.affinity import Affinity, CpuSet
+
+
+class ThreadPool:
+    """Classic work-queue pool (reference BaseThreadPool/ThreadPool)."""
+
+    def __init__(self, n_threads: int, cpus: Optional[CpuSet] = None,
+                 name: str = "pool"):
+        if n_threads < 1:
+            raise ValueError("need at least one thread")
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._shutdown = False
+        self._name = name
+        self._threads: List[threading.Thread] = []
+        for i in range(n_threads):
+            self._spawn(f"{name}-{i}", cpus)
+
+    @classmethod
+    def one_per_cpu(cls, cpus: CpuSet, name: str = "pool") -> "ThreadPool":
+        """One thread pinned per CPU (reference thread_pool.h:108-116)."""
+        if not cpus:
+            raise ValueError("one_per_cpu requires a non-empty CpuSet")
+        self = cls.__new__(cls)
+        self._tasks = queue.Queue()
+        self._shutdown = False
+        self._name = name
+        self._threads = []
+        for cpu in cpus:
+            self._spawn(f"{name}-cpu{cpu}", CpuSet([cpu]))
+        return self
+
+    def _spawn(self, name: str, cpus: Optional[CpuSet]) -> None:
+        t = threading.Thread(target=self._worker, args=(cpus,), name=name,
+                             daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def _worker(self, cpus: Optional[CpuSet]) -> None:
+        if cpus:
+            try:
+                Affinity.set_affinity(cpus)
+            except OSError:  # cpu not in this cgroup — degrade gracefully
+                pass
+        while True:
+            task = self._tasks.get()
+            if task is None:
+                return
+            fn, args, kwargs, fut = task
+            if fut.set_running_or_notify_cancel():
+                try:
+                    fut.set_result(fn(*args, **kwargs))
+                except BaseException as e:  # noqa: BLE001 - promise semantics
+                    fut.set_exception(e)
+
+    @property
+    def size(self) -> int:
+        return len(self._threads)
+
+    def enqueue(self, fn: Callable, *args, **kwargs) -> Future:
+        """Submit work; returns a future (reference ThreadPool::enqueue)."""
+        if self._shutdown:
+            raise RuntimeError("enqueue on stopped ThreadPool")
+        fut: Future = Future()
+        self._tasks.put((fn, args, kwargs, fut))
+        return fut
+
+    submit = enqueue  # concurrent.futures-style alias
+
+    def shutdown(self, wait: bool = True) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for _ in self._threads:
+            self._tasks.put(None)
+        if wait:
+            for t in self._threads:
+                t.join(timeout=10)
+
+    def __enter__(self) -> "ThreadPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
