@@ -1,0 +1,79 @@
+"""Round benchmark: ResNet-50 serving throughput per chip.
+
+Mirrors the reference's headline configuration (examples/00_TensorRT README:
+RN50 INT8 batch=1, pipelined H2D/compute/D2H, synthetic data -> 953.4 inf/s on
+V100): uint8 image bytes in, on-device normalization, full
+InferenceManager/InferRunner pipeline (staging buffers -> async H2D ->
+bucketed compiled dispatch -> coalesced D2H).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...details}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_INF_PER_SEC = 953.4  # reference examples/00_TensorRT/README.md:46
+
+
+def main() -> None:
+    import numpy as np
+    from tpulab.engine import InferBench, InferenceManager
+    from tpulab.models.resnet import make_resnet
+    from tpulab.tpu.device_info import DeviceInfo
+
+    t_start = time.time()
+    model = make_resnet(depth=50, max_batch_size=128, input_dtype=np.uint8,
+                        batch_buckets=[1, 8, 128])
+    mgr = InferenceManager(max_executions=8, max_buffers=32)
+    mgr.register_model("rn50", model)
+    mgr.update_resources()
+    compile_s = time.time() - t_start
+
+    bench = InferBench(mgr)
+    results = {}
+    for b, secs in ((1, 5.0), (8, 5.0), (128, 10.0)):
+        r = bench.run("rn50", batch_size=b, seconds=secs, warmup=4)
+        results[b] = r
+    lat = bench.latency("rn50", batch_size=1, iterations=40)
+
+    # compute-only ceiling (device-resident input, chained dispatch)
+    import jax
+    compiled = mgr.compiled("rn50")
+    dev_in = {"input": jax.device_put(
+        np.zeros((128, 224, 224, 3), np.uint8), mgr.device)}
+    jax.block_until_ready(compiled(128, dev_in))
+    n = 30
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n):
+        out = compiled(128, dev_in)
+    jax.block_until_ready(out)
+    compute_inf_s = 128 * n / (time.perf_counter() - t0)
+
+    headline = results[1]["inferences_per_second"]
+    line = {
+        "metric": "resnet50_infer_per_sec_per_chip_b1",
+        "value": round(headline, 1),
+        "unit": "inf/s",
+        "vs_baseline": round(headline / BASELINE_INF_PER_SEC, 4),
+        "device": DeviceInfo.device_kind(),
+        "details": {
+            "b1_inf_s": round(results[1]["inferences_per_second"], 1),
+            "b8_inf_s": round(results[8]["inferences_per_second"], 1),
+            "b128_inf_s": round(results[128]["inferences_per_second"], 1),
+            "p50_ms_b1": round(lat["p50_ms"], 2),
+            "p99_ms_b1": round(lat["p99_ms"], 2),
+            "compute_only_b128_inf_s": round(compute_inf_s, 1),
+            "compile_s": round(compile_s, 1),
+            "baseline": "examples/00_TensorRT RN50 INT8 b=1 V100 = 953.4 inf/s",
+        },
+    }
+    mgr.shutdown()
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

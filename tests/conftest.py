@@ -2,15 +2,17 @@
 
 Tests run hermetically on CPU with 8 virtual XLA devices so multi-chip sharding
 logic is exercised without TPU hardware (the driver separately dry-runs the
-multi-chip path; bench.py runs on the real chip).  This must happen before jax
-is imported anywhere.
+multi-chip path; bench.py runs on the real chip).
+
+NOTE: the JAX_PLATFORMS env var is ignored when the experimental 'axon' TPU
+plugin is present — force_cpu() uses the config API instead, before any
+backend is created.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+from tpulab.tpu.platform import force_cpu  # noqa: E402
+
+force_cpu(8)

@@ -1,0 +1,236 @@
+"""Engine runtime tests (reference tensorrt/tests + the v1 serving semantics
+exercised by examples: register -> pools -> runner -> numbers out)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tpulab.engine import (Bindings, Buffers, InferBench, InferenceManager,
+                           IOSpec, Model, Runtime,
+                           StaticSingleModelGraphWorkspace,
+                           TimedBenchmarkWorkspace, default_batch_buckets)
+from tpulab.models import build_model
+from tpulab.models.mnist import make_mnist, mnist_apply
+
+
+# ----------------------------------------------------------------- model ---
+def test_default_batch_buckets():
+    assert default_batch_buckets(8) == [1, 2, 4, 8]
+    assert default_batch_buckets(6) == [1, 2, 4, 6]
+    assert default_batch_buckets(1) == [1]
+
+
+def test_model_introspection():
+    m = make_mnist(max_batch_size=8)
+    assert m.binding_names == ["Input3", "Plus214_Output_0"]
+    assert m.is_input("Input3") and not m.is_input("Plus214_Output_0")
+    assert m.binding_size_in_bytes("Input3", 2) == 2 * 28 * 28 * 4
+    assert m.element_count("Plus214_Output_0", 4) == 40
+    assert m.weights_size_in_bytes() > 0
+    assert m.pick_bucket(3) == 4 and m.pick_bucket(8) == 8
+    with pytest.raises(ValueError):
+        m.pick_bucket(9)
+
+
+# --------------------------------------------------------------- runtime ---
+def test_runtime_compiles_buckets():
+    rt = Runtime()
+    m = make_mnist(max_batch_size=4)
+    compiled = rt.compile_model(m)
+    assert sorted(compiled.executables) == [1, 2, 4]
+    x = np.zeros((2, 28, 28, 1), np.float32)
+    out = compiled(2, {"Input3": x})
+    assert out["Plus214_Output_0"].shape == (2, 10)
+
+
+def test_engine_artifact_roundtrip(tmp_path):
+    rt = Runtime()
+    m = make_mnist(max_batch_size=2)
+    compiled = rt.compile_model(m)
+    x = np.random.default_rng(0).standard_normal((1, 28, 28, 1)).astype(np.float32)
+    want = np.asarray(compiled(1, {"Input3": x})["Plus214_Output_0"])
+    path = str(tmp_path / "mnist_engine")
+    rt.save_engine(compiled, path)
+    loaded = rt.load_engine(path, apply_fn=mnist_apply)
+    got = np.asarray(loaded(1, {"Input3": x})["Plus214_Output_0"])
+    np.testing.assert_allclose(want, got, rtol=1e-5)
+    assert loaded.model.batch_buckets == [1, 2]
+
+
+# ------------------------------------------------------ buffers/bindings ---
+def test_bindings_carve_fill_roundtrip():
+    m = make_mnist(max_batch_size=4)
+    buffers = Buffers(m.bindings_size_in_bytes() + 128 * 1024)
+    b = buffers.create_bindings(m, batch_size=3)
+    assert b.bucket == 4  # padded to bucket
+    data = np.random.default_rng(1).standard_normal((3, 28, 28, 1)).astype(np.float32)
+    b.set_input("Input3", data)
+    np.testing.assert_array_equal(b.host_inputs["Input3"][:3], data)
+    assert (b.host_inputs["Input3"][3:] == 0).all()  # deterministic padding
+    with pytest.raises(ValueError):
+        b.set_input("Input3", data[:2])  # batch mismatch
+    with pytest.raises(KeyError):
+        b.set_input("Plus214_Output_0", data)  # not an input
+    b.release()
+    buffers.reset()
+
+
+# ------------------------------------------------------------- manager -----
+@pytest.fixture(scope="module")
+def manager():
+    mgr = InferenceManager(max_executions=2)
+    mgr.register_model("mnist", make_mnist(max_batch_size=4))
+    mgr.update_resources()
+    yield mgr
+    mgr.shutdown()
+
+
+def test_manager_requires_allocation():
+    mgr = InferenceManager()
+    mgr.register_model("m", make_mnist(max_batch_size=1))
+    with pytest.raises(RuntimeError):
+        mgr.get_buffers()
+    with pytest.raises(RuntimeError):
+        mgr.infer_runner("m").infer(Input3=np.zeros((1, 28, 28, 1), np.float32))
+    mgr.shutdown()
+
+
+def test_manager_two_level_acquisition(manager):
+    with manager.get_execution_context("mnist") as ctx:
+        assert ctx.model.name == "mnist"
+    # tokens and contexts returned
+    m2 = manager.get_execution_context("mnist")
+    m2.release()
+
+
+def test_infer_runner_end_to_end(manager):
+    runner = manager.infer_runner("mnist")
+    x = np.random.default_rng(2).standard_normal((2, 28, 28, 1)).astype(np.float32)
+    fut = runner.infer(Input3=x)
+    out = fut.result(timeout=60)
+    assert out["Plus214_Output_0"].shape == (2, 10)
+    # numerical parity with a direct jax call (golden check, reference
+    # run_onnx_tests-style np.testing comparison)
+    direct = manager.compiled("mnist")(2, {"Input3": x})["Plus214_Output_0"]
+    np.testing.assert_allclose(out["Plus214_Output_0"], np.asarray(direct),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_infer_runner_concurrent_saturation(manager):
+    runner = manager.infer_runner("mnist")
+    x = np.zeros((1, 28, 28, 1), np.float32)
+    futs = [runner.infer(Input3=x) for _ in range(32)]
+    outs = [f.result(timeout=60) for f in futs]
+    assert len(outs) == 32
+    assert all(o["Plus214_Output_0"].shape == (1, 10) for o in outs)
+
+
+def test_infer_runner_post_fn(manager):
+    runner = manager.infer_runner("mnist")
+    x = np.zeros((1, 28, 28, 1), np.float32)
+    fut = runner.infer(post_fn=lambda b: int(np.argmax(b.outputs()["Plus214_Output_0"])),
+                       **{"Input3": x})
+    assert isinstance(fut.result(timeout=60), int)
+
+
+def test_infer_runner_unknown_model(manager):
+    with pytest.raises(KeyError):
+        manager.infer_runner("nope")
+
+
+def test_multi_model_concurrency():
+    """Per-model pools under one token pool (reference SURVEY §2.8 axis 3)."""
+    mgr = InferenceManager(max_executions=2)
+    mgr.register_model("mnist_a", make_mnist(max_batch_size=2, seed=1))
+    mgr.register_model("mnist_b", make_mnist(max_batch_size=2, seed=2))
+    mgr.update_resources()
+    try:
+        ra, rb = mgr.infer_runner("mnist_a"), mgr.infer_runner("mnist_b")
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        futs = [r.infer(Input3=x) for r in (ra, rb) for _ in range(4)]
+        outs = [f.result(timeout=60) for f in futs]
+        assert len(outs) == 8
+    finally:
+        mgr.shutdown()
+
+
+# ----------------------------------------------------------- workspaces ----
+def test_static_workspace_enqueue():
+    m = make_mnist(max_batch_size=2)
+    ws = StaticSingleModelGraphWorkspace(m, batch_size=2)
+    out = ws.enqueue()
+    ws.synchronize()
+    assert np.asarray(out["Plus214_Output_0"]).shape == (2, 10)
+
+
+def test_timed_workspace_stages():
+    m = make_mnist(max_batch_size=1)
+    ws = TimedBenchmarkWorkspace(m, batch_size=1)
+    ws.host_inputs["Input3"][:] = 1.0
+    t = ws.timed_run()
+    assert set(t) == {"h2d_ms", "compute_ms", "d2h_ms", "total_ms"}
+    assert t["total_ms"] > 0
+    assert np.isfinite(ws.host_outputs["Plus214_Output_0"]).all()
+
+
+# ------------------------------------------------------------- bench -------
+def test_infer_bench_smoke(manager):
+    bench = InferBench(manager)
+    res = bench.run("mnist", batch_size=2, seconds=0.5, warmup=2)
+    assert res["inferences_per_second"] > 0
+    assert res["batches_computed"] >= 1
+    lat = bench.latency("mnist", batch_size=1, iterations=10)
+    assert lat["p99_ms"] >= lat["p50_ms"] > 0
+
+
+# ------------------------------------------------------------- registry ----
+def test_registry_builds():
+    m = build_model("mnist", max_batch_size=2)
+    assert m.name == "mnist"
+    with pytest.raises(KeyError):
+        build_model("nope")
+
+
+# -------------------------------------------- regression: review findings ---
+def test_multi_device_dispatcher_routes_to_all_chips():
+    """Executables must bind to their manager's device (review finding)."""
+    import jax
+    from tpulab.parallel import MultiDeviceDispatcher
+    from tpulab.models.mnist import make_mnist
+    disp = MultiDeviceDispatcher.create(
+        lambda: make_mnist(max_batch_size=1), "mnist",
+        devices=jax.devices()[:2], max_executions=1)
+    try:
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        futs = [disp.infer("mnist", Input3=x) for _ in range(4)]  # rr both devices
+        outs = [f.result(timeout=60) for f in futs]
+        assert all(o["Plus214_Output_0"].shape == (1, 10) for o in outs)
+    finally:
+        disp.shutdown()
+
+
+def test_failed_dispatch_does_not_strand_token():
+    """A dispatch-stage error must return the execution token (review finding)."""
+    mgr = InferenceManager(max_executions=1)
+    mgr.register_model("m", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    try:
+        runner = mgr.infer_runner("m")
+        bad = np.zeros((1, 28, 28, 1), np.float32)
+        # sabotage: force ctx.infer to fail by corrupting device inputs
+        import tpulab.engine.execution_context as ec
+        orig = ec.ExecutionContext.infer
+        ec.ExecutionContext.infer = lambda self, di, b: (_ for _ in ()).throw(
+            RuntimeError("injected"))
+        try:
+            fut = runner.infer(Input3=bad)
+            with pytest.raises(RuntimeError, match="injected"):
+                fut.result(timeout=30)
+        finally:
+            ec.ExecutionContext.infer = orig
+        # token must be back: a healthy request succeeds promptly
+        out = runner.infer(Input3=bad).result(timeout=30)
+        assert out["Plus214_Output_0"].shape == (1, 10)
+    finally:
+        mgr.shutdown()

@@ -1,0 +1,45 @@
+"""tpulab.engine — the executable runtime (reference trtlab/tensorrt, §2.5).
+
+The reference's object model, re-grounded on XLA:
+
+| reference (TensorRT)                  | tpulab (XLA/PjRt)                     |
+|---------------------------------------|---------------------------------------|
+| serialized engine "plan" file         | engine artifact: StableHLO + params + |
+|                                       | per-bucket serialized executables     |
+| Runtime::deserialize_engine           | Runtime.load_engine / compile_model   |
+| optimization profiles (min/opt/max)   | batch buckets (1,2,4,...,max), padded |
+| ICudaEngine introspection             | Model binding specs + memory_analysis |
+| IExecutionContext w/o device memory   | ExecutionContext (execution slot;     |
+|                                       | scratch is XLA-managed, slot-pooled)  |
+| cudaGraph capture + graphLaunch       | jit-compiled program (XLA compiles    |
+|                                       | the whole graph; dispatch is a single |
+|                                       | pre-compiled call)                    |
+| InferenceManager pools                | InferenceManager pools (same design)  |
+| Bindings/Buffers host+device stacks   | Bindings over pinned staging views +  |
+|                                       | device arrays                         |
+| InferRunner 3-stage pre/cuda/post     | InferRunner 3-stage pre/dispatch/post |
+| InferBench                            | InferBench                            |
+"""
+
+from tpulab.engine.model import IOSpec, Model, default_batch_buckets
+from tpulab.engine.runtime import Runtime, CompiledModel
+from tpulab.engine.execution_context import ExecutionContext
+from tpulab.engine.buffers import Buffers, Bindings
+from tpulab.engine.inference_manager import InferenceManager
+from tpulab.engine.infer_runner import InferRunner
+from tpulab.engine.infer_bench import InferBench
+from tpulab.engine.workspace import (
+    StaticSingleModelGraphWorkspace,
+    BenchmarkWorkspace,
+    TimedBenchmarkWorkspace,
+)
+
+__all__ = [
+    "IOSpec", "Model", "default_batch_buckets",
+    "Runtime", "CompiledModel",
+    "ExecutionContext",
+    "Buffers", "Bindings",
+    "InferenceManager", "InferRunner", "InferBench",
+    "StaticSingleModelGraphWorkspace", "BenchmarkWorkspace",
+    "TimedBenchmarkWorkspace",
+]

@@ -1,0 +1,145 @@
+"""Buffers/Bindings: per-request host staging + device tensors
+(reference buffers.h:51-155, bindings.h:59-121 — host+device memory stacks
+with per-binding carve-out, async copies on the buffers' stream).
+
+TPU shape of the same design:
+- ``Buffers`` owns a pinned-host staging stack (BlockStack over the staging
+  allocator).  Device memory is *not* pre-carved: XLA owns layouts/tiling, so
+  device tensors materialize at transfer; the Buffers' pool slot is what
+  bounds per-request memory (the reference's backpressure role).
+- ``Bindings`` carves one padded numpy view per input binding off the staging
+  stack (zero-copy for the user's fill), dispatches async H2D per binding
+  (``copy_to_device``), holds the resulting device arrays, and lands outputs
+  back into staging views on D2H.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from tpulab.core.dtypes import dtype_from_numpy  # noqa: F401 (re-export)
+from tpulab.engine.model import Model
+from tpulab.memory.arena import BlockArena, BlockStack
+from tpulab.memory.block import FixedSizeBlockAllocator
+from tpulab.tpu.allocators import make_staging_allocator
+from tpulab.tpu.copy import copy_to_device, copy_to_host
+from tpulab.tpu.sync import tpu_sync_standard
+
+
+class Buffers:
+    """One pool slot of staging memory (reference FixedBuffers)."""
+
+    def __init__(self, host_stack_bytes: int, device=None, block_size: int = 0,
+                 transfer_engine=None):
+        block = block_size or host_stack_bytes
+        self._arena = BlockArena(
+            FixedSizeBlockAllocator(make_staging_allocator(), block),
+            cached=True)
+        self._stack = BlockStack(self._arena)
+        self.device = device
+        self.transfer_engine = transfer_engine
+
+    def create_bindings(self, model: Model, batch_size: int) -> "Bindings":
+        """Carve per-binding staging views (reference CreateBindings)."""
+        return Bindings(self, model, batch_size)
+
+    def _carve(self, nbytes: int) -> np.ndarray:
+        from tpulab.memory.descriptor import host_view
+        addr = self._stack.allocate(nbytes, alignment=64)
+        return np.frombuffer(host_view(addr, nbytes), dtype=np.uint8)
+
+    def reset(self) -> None:
+        """Return all carved memory (runs as the pool's on_return hook)."""
+        self._stack.reset()
+
+    def release(self) -> None:
+        self._stack.reset()
+        self._arena.shrink_to_fit()
+
+
+class Bindings:
+    """Per-inference tensor state (reference Bindings).
+
+    Lifecycle: fill host views -> ``copy_to_device()`` -> execute ->
+    ``copy_from_device(outputs)`` -> ``synchronize()`` -> read host outputs.
+    """
+
+    def __init__(self, buffers: Buffers, model: Model, batch_size: int):
+        self.model = model
+        self.batch_size = batch_size
+        self.bucket = model.pick_bucket(batch_size)
+        self.device = buffers.device
+        self._buffers = buffers
+        self.host_inputs: Dict[str, np.ndarray] = {}
+        self.host_outputs: Dict[str, np.ndarray] = {}
+        self.device_inputs: Dict[str, Any] = {}
+        self.device_outputs: Dict[str, Any] = {}
+        for spec in model.inputs:
+            raw = buffers._carve(spec.bytes_per_sample() * self.bucket)
+            arr = raw.view(spec.np_dtype).reshape(spec.batched_shape(self.bucket))
+            self.host_inputs[spec.name] = arr
+        for spec in model.outputs:
+            raw = buffers._carve(spec.bytes_per_sample() * self.bucket)
+            arr = raw.view(spec.np_dtype).reshape(spec.batched_shape(self.bucket))
+            self.host_outputs[spec.name] = arr
+
+    # -- fill ---------------------------------------------------------------
+    def set_input(self, name: str, array: np.ndarray) -> None:
+        """Copy user data into the staging view (pads to the bucket)."""
+        spec = self.model.binding(name)
+        if not self.model.is_input(name):
+            raise KeyError(f"{name} is not an input binding")
+        view = self.host_inputs[name]
+        n = array.shape[0]
+        if n != self.batch_size:
+            raise ValueError(f"input {name} batch {n} != bindings batch "
+                             f"{self.batch_size}")
+        view[:n] = array
+        if n < self.bucket:
+            view[n:] = 0  # deterministic padding
+
+    # -- transfers ----------------------------------------------------------
+    def copy_to_device(self) -> None:
+        """Async H2D of every input binding (reference CopyToDevice)."""
+        for name, host in self.host_inputs.items():
+            self.device_inputs[name] = copy_to_device(host, self.device)
+
+    def copy_from_device(self, outputs: Dict[str, Any]) -> None:
+        """Record device outputs; D2H lands in staging on synchronize()
+        (reference CopyFromDevice async D2H)."""
+        self.device_outputs = dict(outputs)
+
+    def synchronize(self) -> Dict[str, np.ndarray]:
+        """Block until results; materialize host output views
+        (reference Bindings::Synchronize).
+
+        Goes through the shared TransferEngine when available so concurrent
+        requests share one D2H flush (see tpulab.tpu.transfer)."""
+        engine = self._buffers.transfer_engine
+        if engine is not None:
+            host = engine.fetch_sync(self.device_outputs)
+            for name, arr in host.items():
+                out = self.host_outputs.get(name)
+                if out is not None:
+                    np.copyto(out, arr)
+        else:
+            tpu_sync_standard(self.device_outputs)
+            for name, dev in self.device_outputs.items():
+                out = self.host_outputs.get(name)
+                if out is not None:
+                    copy_to_host(dev, out)
+        return {n: self.host_outputs[n][:self.batch_size]
+                for n in self.host_outputs}
+
+    def outputs(self) -> Dict[str, np.ndarray]:
+        """Unpadded host outputs (valid after synchronize)."""
+        return {n: self.host_outputs[n][:self.batch_size]
+                for n in self.host_outputs}
+
+    def release(self) -> None:
+        self.host_inputs.clear()
+        self.host_outputs.clear()
+        self.device_inputs.clear()
+        self.device_outputs.clear()

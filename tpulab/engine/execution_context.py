@@ -1,0 +1,46 @@
+"""ExecutionContext: a pooled execution slot
+(reference execution_context.h:20-51 — IExecutionContext created *without*
+device memory so activation scratch is externally owned).
+
+On TPU, XLA owns activation scratch inside the compiled program, so the
+context is a pure *concurrency token* bound to a CompiledModel: holding one is
+the right to have a dispatch in flight (reference SURVEY §7 "keep the
+token-pool semantics even if memory is runtime-managed").  ``infer`` dispatches
+asynchronously and returns device outputs immediately; ``synchronize`` blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from tpulab.engine.runtime import CompiledModel
+
+
+class ExecutionContext:
+    """Execution slot over one compiled model (reference ExecutionContext)."""
+
+    def __init__(self, compiled: CompiledModel, slot_id: int = 0):
+        self.compiled = compiled
+        self.slot_id = slot_id
+        self._last_outputs: Optional[Dict[str, Any]] = None
+
+    @property
+    def model(self):
+        return self.compiled.model
+
+    def infer(self, device_inputs: Dict[str, Any], bucket: int) -> Dict[str, Any]:
+        """Async dispatch of the pre-compiled program for ``bucket``
+        (the cudaGraphLaunch analog — no tracing, no building, one call)."""
+        outputs = self.compiled(bucket, device_inputs)
+        self._last_outputs = outputs
+        return outputs
+
+    def synchronize(self) -> None:
+        """Block until the last dispatch completes (reference ctx Synchronize)."""
+        from tpulab.tpu.sync import tpu_sync_standard
+        if self._last_outputs is not None:
+            tpu_sync_standard(self._last_outputs)
+            self._last_outputs = None
+
+    def binding_size_in_bytes(self, name: str, batch_size: int) -> int:
+        return self.model.binding_size_in_bytes(name, batch_size)

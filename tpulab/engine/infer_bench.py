@@ -1,0 +1,85 @@
+"""InferBench: saturating throughput benchmark
+(reference infer_bench.h / infer_bench.cc:46-110; result keys :90-98)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class InferBench:
+    """Timed benchmark loop over a registered model (reference InferBench)."""
+
+    def __init__(self, manager):
+        self._mgr = manager
+
+    def run(self, model_name: str, batch_size: int = 1,
+            seconds: float = 5.0, warmup: int = 8) -> Dict[str, float]:
+        """Saturate the pools for ``seconds``; returns the reference's metric
+        map: batch_size, max concurrency, batches computed, walltime,
+        batches/sec, inf/sec, execution time per batch."""
+        runner = self._mgr.infer_runner(model_name)
+        model = self._mgr.model(model_name)
+        inputs = {
+            s.name: np.random.default_rng(0).standard_normal(
+                s.batched_shape(batch_size)).astype(s.np_dtype)
+            for s in model.inputs
+        }
+        # warmup: compile-cache everything and fill pipelines
+        for _ in range(warmup):
+            runner.infer(**inputs).result(timeout=120)
+
+        inflight: List = []
+        max_inflight = self._mgr.max_buffers  # pipeline depth = buffers pool
+        batches = 0
+        start = time.perf_counter()
+        deadline = start + seconds
+        while time.perf_counter() < deadline:
+            while len(inflight) >= max_inflight:
+                inflight.pop(0).result(timeout=120)
+                batches += 1
+            inflight.append(runner.infer(**inputs))
+        for f in inflight:
+            f.result(timeout=120)
+            batches += 1
+        walltime = time.perf_counter() - start
+
+        batches_per_sec = batches / walltime
+        return {
+            "batch_size": batch_size,
+            "max_concurrency": float(max_inflight),
+            "batches_computed": float(batches),
+            "walltime_s": walltime,
+            "batches_per_second": batches_per_sec,
+            "inferences_per_second": batches_per_sec * batch_size,
+            "execution_time_per_batch_ms": 1000.0 / batches_per_sec,
+        }
+
+    def latency(self, model_name: str, batch_size: int = 1,
+                iterations: int = 100) -> Dict[str, float]:
+        """Closed-loop latency percentiles (p50/p90/p99) — the BASELINE.json
+        metric definition (not published in the reference repo)."""
+        runner = self._mgr.infer_runner(model_name)
+        model = self._mgr.model(model_name)
+        inputs = {
+            s.name: np.zeros(s.batched_shape(batch_size), s.np_dtype)
+            for s in model.inputs
+        }
+        for _ in range(8):
+            runner.infer(**inputs).result(timeout=120)
+        lats = []
+        for _ in range(iterations):
+            t0 = time.perf_counter()
+            runner.infer(**inputs).result(timeout=120)
+            lats.append((time.perf_counter() - t0) * 1000.0)
+        arr = np.asarray(lats)
+        return {
+            "batch_size": batch_size,
+            "iterations": iterations,
+            "p50_ms": float(np.percentile(arr, 50)),
+            "p90_ms": float(np.percentile(arr, 90)),
+            "p99_ms": float(np.percentile(arr, 99)),
+            "mean_ms": float(arr.mean()),
+        }

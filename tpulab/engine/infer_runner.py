@@ -1,0 +1,121 @@
+"""InferRunner: the three-stage async pipeline (reference infer_runner.h:37-157,
+call stack SURVEY §3.2).
+
+Stage map (reference -> here):
+- caller/pre: get_buffers [MAY BLOCK] -> create bindings -> fill host inputs
+- "dispatch" worker (reference "cuda" thread): async H2D, two-level context
+  acquisition [MAY BLOCK], async program dispatch, async D2H record — the
+  dispatch thread only *launches* async work, so one thread keeps many
+  contexts busy (reference hot-loop note §3.2)
+- "post" worker: blocks on device completion, returns the context token,
+  lands outputs in staging, runs the user's post_fn, returns buffers,
+  fulfills the future
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from tpulab.core.async_compute import SharedPackagedTask
+from tpulab.engine.buffers import Bindings
+
+
+class InferRunner:
+    """Future-returning inference pipeline bound to one model
+    (reference InferRunner)."""
+
+    def __init__(self, manager, model_name: str):
+        self._mgr = manager
+        self.model = manager.model(model_name)
+        self.model_name = model_name
+
+    # -- public API ---------------------------------------------------------
+    def infer(self, post_fn: Optional[Callable[[Bindings], Any]] = None,
+              **arrays: np.ndarray) -> Future:
+        """Run inference on named input arrays; returns a future of
+        ``post_fn(bindings)`` (default: dict of output arrays)."""
+        if not arrays:
+            raise ValueError("no input arrays given")
+        batch = next(iter(arrays.values())).shape[0]
+        buffers_item = self._mgr.get_buffers()           # MAY BLOCK (backpressure)
+        bindings = buffers_item.get().create_bindings(self.model, batch)
+        for name, arr in arrays.items():
+            bindings.set_input(name, np.ascontiguousarray(arr))
+        return self.infer_bindings(bindings, buffers_item, post_fn)
+
+    def infer_bindings(self, bindings: Bindings, buffers_item,
+                       post_fn: Optional[Callable[[Bindings], Any]] = None) -> Future:
+        """Pipeline entry for pre-filled bindings (reference Infer(bindings))."""
+        post_fn = post_fn or (lambda b: {k: v.copy() for k, v in b.outputs().items()})
+        task: SharedPackagedTask = SharedPackagedTask(post_fn)
+        future = task.get_future()
+        self._mgr.workers("dispatch").enqueue(
+            self._dispatch_stage, bindings, buffers_item, task)
+        return future
+
+    # -- stages -------------------------------------------------------------
+    def _dispatch_stage(self, bindings: Bindings, buffers_item,
+                        task: SharedPackagedTask) -> None:
+        managed = None
+        try:
+            bindings.copy_to_device()                    # async H2D
+            managed = self._mgr.get_execution_context(   # MAY BLOCK (2-level pop)
+                self.model_name)
+            ctx = managed.get()
+            outputs = ctx.infer(bindings.device_inputs, bindings.bucket)  # async
+            bindings.copy_from_device(outputs)           # record async D2H source
+            poller = self._mgr.event_poller
+            engine = self._mgr.transfer_engine
+            if poller is not None and engine is not None:
+                # execution token returns the moment *compute* is done
+                # (reference post stage ctx sync-then-reset, infer_runner.h:93);
+                # D2H rides the coalescing TransferEngine and the post stage
+                # chains on its future — post threads never block on fetches.
+                poller.watch(outputs, managed.release)
+                fetch = engine.fetch(outputs)
+                fetch.add_done_callback(
+                    lambda f: self._mgr.workers("post").enqueue(
+                        self._post_stage_fetched, bindings, buffers_item,
+                        task, f))
+            else:
+                self._mgr.workers("post").enqueue(
+                    self._post_stage, bindings, buffers_item, managed, task)
+        except BaseException as e:  # noqa: BLE001
+            if managed is not None:
+                managed.release()                        # token must not strand
+            buffers_item.release()
+            if not task.get_future().done():
+                task.get_future().set_exception(e)
+
+    def _post_stage_fetched(self, bindings: Bindings, buffers_item,
+                            task: SharedPackagedTask, fetch_fut) -> None:
+        try:
+            host = fetch_fut.result()
+            for name, arr in host.items():
+                out = bindings.host_outputs.get(name)
+                if out is not None:
+                    np.copyto(out, arr)
+            task(bindings)                               # user post fn -> future
+        except BaseException as e:  # noqa: BLE001
+            if not task.get_future().done():
+                task.get_future().set_exception(e)
+        finally:
+            bindings.release()
+            buffers_item.release()
+
+    def _post_stage(self, bindings: Bindings, buffers_item, managed,
+                    task: SharedPackagedTask) -> None:
+        try:
+            bindings.synchronize()                       # block on compute+D2H
+            managed.release()                            # token back first
+            task(bindings)                               # user post fn -> future
+        except BaseException as e:  # noqa: BLE001
+            if not task.get_future().done():
+                task.get_future().set_exception(e)
+        finally:
+            managed.release()                            # idempotent safety net
+            bindings.release()
+            buffers_item.release()                       # buffers back to pool

@@ -1,0 +1,202 @@
+"""InferenceManager: the central resource manager
+(reference inference_manager.cc:59-330).
+
+Owns, exactly as the reference does:
+- registered models -> CompiledModels (per-bucket executables, weights in HBM)
+- a global ``Pool[Buffers]`` of staging bundles sized to the largest
+  registered model (max-reduce over models, reference :110-117), with
+  ``max_buffers = 2 * max_executions`` by default (reference :59-62) so one
+  H2D, N computes, and one D2H overlap (SURVEY §2.8 axis 2)
+- a global execution-token ``Pool`` bounding in-flight dispatches plus a
+  per-model ``Pool[ExecutionContext]`` — ``get_execution_context`` does the
+  two-level pop (global token, then model slot; reference :254-273) and both
+  block when exhausted: natural backpressure
+- named thread pools ("pre", "dispatch", "post"; reference "pre"/"cuda"/"post")
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Iterable, Optional
+
+from tpulab.core.pool import Pool, PoolItem
+from tpulab.core.thread_pool import ThreadPool
+from tpulab.engine.buffers import Buffers
+from tpulab.engine.execution_context import ExecutionContext
+from tpulab.engine.model import Model
+from tpulab.engine.runtime import CompiledModel, Runtime
+from tpulab.tpu import platform as plat
+
+log = logging.getLogger("tpulab.engine")
+
+
+class InferenceManager:
+    """Pools + models + thread pools (reference InferenceManager)."""
+
+    def __init__(self, max_executions: int = 2, max_buffers: int = 0,
+                 device=None):
+        if max_executions < 1:
+            raise ValueError("max_executions must be >= 1")
+        self.max_executions = max_executions
+        self.max_buffers = max_buffers or 2 * max_executions  # reference :59-62
+        self.device = device if device is not None else plat.local_device(0)
+        self._runtime = Runtime(self.device)
+        self._models: Dict[str, Model] = {}
+        self._compiled: Dict[str, CompiledModel] = {}
+        self._ctx_pools: Dict[str, Pool[ExecutionContext]] = {}
+        self._buffers_pool: Optional[Pool[Buffers]] = None
+        self._exec_tokens: Optional[Pool[int]] = None
+        self._transfer_engine = None
+        self._event_poller = None
+        self._thread_pools: Dict[str, ThreadPool] = {}
+        self._lock = threading.Lock()
+        self._allocated = False
+
+    # -- registration (reference RegisterModel :92-156) ---------------------
+    def register_model(self, name: str, model: Model,
+                       max_concurrency: Optional[int] = None) -> None:
+        """Compile + register; per-model context slots = max_concurrency
+        (default: manager max_executions, reference :151-155)."""
+        if self._allocated:
+            raise RuntimeError("register models before update_resources()")
+        model = model if model.name == name else _renamed(model, name)
+        compiled = self._runtime.compile_model(model)
+        slots = max_concurrency or self.max_executions
+        with self._lock:
+            self._models[name] = model
+            self._compiled[name] = compiled
+            self._ctx_pools[name] = Pool(
+                ExecutionContext(compiled, slot_id=i) for i in range(slots))
+        act = compiled.activation_size_in_bytes()
+        log.info("registered %s: weights=%dB activations~%dB buckets=%s",
+                 name, model.weights_size_in_bytes(), act, model.batch_buckets)
+
+    def register_engine(self, name: str, path: str, apply_fn,
+                        max_concurrency: Optional[int] = None) -> None:
+        """Load a serialized engine artifact (reference
+        RegisterModel(name, DeserializeEngine(path)))."""
+        if self._allocated:
+            raise RuntimeError("register engines before update_resources()")
+        compiled = self._runtime.load_engine(path, apply_fn=apply_fn,
+                                             model_name=name)
+        slots = max_concurrency or self.max_executions
+        with self._lock:
+            self._models[name] = compiled.model
+            self._compiled[name] = compiled
+            self._ctx_pools[name] = Pool(
+                ExecutionContext(compiled, slot_id=i) for i in range(slots))
+
+    # -- resource allocation (reference AllocateResources :181-205) ---------
+    def update_resources(self) -> None:
+        if not self._models:
+            raise RuntimeError("no models registered")
+        # max-reduce staging bytes over models (reference :110-117), with
+        # 128KiB headroom per bundle for alignment carve-out
+        stack_bytes = max(m.bindings_size_in_bytes() for m in self._models.values())
+        stack_bytes += 128 * 1024
+        from tpulab.tpu.sync import EventPoller
+        from tpulab.tpu.transfer import TransferEngine
+        self._transfer_engine = TransferEngine()
+        self._event_poller = EventPoller()
+        self._buffers_pool = Pool(
+            (Buffers(stack_bytes, self.device,
+                     transfer_engine=self._transfer_engine)
+             for _ in range(self.max_buffers)),
+            on_return=Buffers.reset)
+        self._exec_tokens = Pool(range(self.max_executions))
+        for name in ("pre", "dispatch", "post"):
+            if name not in self._thread_pools:
+                self._thread_pools[name] = ThreadPool(2, name=name)
+        self._allocated = True
+        log.info("resources: %d buffer bundles x %dB, %d exec tokens",
+                 self.max_buffers, stack_bytes, self.max_executions)
+
+    def register_thread_pool(self, name: str, pool: ThreadPool) -> None:
+        """Named pool registry (reference RegisterThreadPool)."""
+        self._thread_pools[name] = pool
+
+    def workers(self, name: str) -> ThreadPool:
+        return self._thread_pools[name]
+
+    # -- acquisition (blocking; reference :232-273) -------------------------
+    def get_buffers(self, timeout: Optional[float] = None) -> PoolItem[Buffers]:
+        self._check_allocated()
+        return self._buffers_pool.pop(timeout)
+
+    def get_execution_context(self, model_name: str,
+                              timeout: Optional[float] = None) -> "ManagedContext":
+        """Two-level pop: global token then model slot (reference :254-273)."""
+        self._check_allocated()
+        token = self._exec_tokens.pop(timeout)
+        try:
+            ctx = self._ctx_pools[model_name].pop(timeout)
+        except BaseException:
+            token.release()
+            raise
+        return ManagedContext(ctx, token)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def transfer_engine(self):
+        return self._transfer_engine
+
+    @property
+    def event_poller(self):
+        return self._event_poller
+
+    def model(self, name: str) -> Model:
+        return self._models[name]
+
+    def compiled(self, name: str) -> CompiledModel:
+        return self._compiled[name]
+
+    @property
+    def model_names(self):
+        return list(self._models)
+
+    def infer_runner(self, name: str):
+        from tpulab.engine.infer_runner import InferRunner
+        if name not in self._models:
+            raise KeyError(f"model {name!r} is not registered")
+        return InferRunner(self, name)
+
+    def _check_allocated(self) -> None:
+        if not self._allocated:
+            raise RuntimeError("call update_resources() first")
+
+    def shutdown(self) -> None:
+        for tp in self._thread_pools.values():
+            tp.shutdown()
+        if self._transfer_engine is not None:
+            self._transfer_engine.shutdown()
+        if self._event_poller is not None:
+            self._event_poller.shutdown()
+
+
+class ManagedContext:
+    """The two-level (token + context) acquisition handle."""
+
+    def __init__(self, ctx_item: PoolItem[ExecutionContext],
+                 token_item: PoolItem[int]):
+        self._ctx_item = ctx_item
+        self._token_item = token_item
+
+    def get(self) -> ExecutionContext:
+        return self._ctx_item.get()
+
+    def release(self) -> None:
+        """Return context first, then the global token (reference order)."""
+        self._ctx_item.release()
+        self._token_item.release()
+
+    def __enter__(self) -> ExecutionContext:
+        return self.get()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def _renamed(model: Model, name: str) -> Model:
+    return Model(name, model.apply_fn, model.params, model.inputs,
+                 model.outputs, model.max_batch_size, model.batch_buckets)

@@ -1,0 +1,124 @@
+"""Model: a named program + weights + IO contract (reference model.h:17-47,
+model.cc:39-117 — engine introspection, binding info, optimization profiles).
+
+A ``Model`` owns:
+- ``apply_fn(params, inputs) -> outputs`` — a pure JAX function (dict in/out)
+- ``params`` — the weight pytree (the reference's captured weights; Model owns
+  them, reference runtime.cc:134-143 weight-capture)
+- input/output ``IOSpec``s — named bindings with per-sample shapes/dtypes
+  (reference binding introspection model.cc:73-117)
+- ``batch_buckets`` — the supported batch sizes.  XLA compiles static shapes,
+  so dynamic batch is served by padding up to the nearest bucket — the
+  TPU-native replacement for TensorRT optimization profiles (model.cc:39-71):
+  each bucket is one compiled program, chosen at dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def default_batch_buckets(max_batch_size: int) -> List[int]:
+    """Powers of two up to max (plus max itself): 1,2,4,...,max."""
+    if max_batch_size < 1:
+        raise ValueError("max_batch_size must be >= 1")
+    buckets = []
+    b = 1
+    while b < max_batch_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch_size)
+    return buckets
+
+
+@dataclasses.dataclass(frozen=True)
+class IOSpec:
+    """One named binding (reference binding info: name/dims/dtype/size)."""
+
+    name: str
+    shape: Tuple[int, ...]       # per-sample shape (no batch dim)
+    dtype: Any = np.float32
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(self.dtype)
+
+    def elements_per_sample(self) -> int:
+        return int(math.prod(self.shape)) if self.shape else 1
+
+    def bytes_per_sample(self) -> int:
+        return self.elements_per_sample() * self.np_dtype.itemsize
+
+    def batched_shape(self, batch_size: int) -> Tuple[int, ...]:
+        return (batch_size, *self.shape)
+
+
+class Model:
+    """A servable model (reference Model wrapping ICudaEngine + weights)."""
+
+    def __init__(self, name: str,
+                 apply_fn: Callable[[Any, Dict[str, Any]], Dict[str, Any]],
+                 params: Any,
+                 inputs: Sequence[IOSpec],
+                 outputs: Sequence[IOSpec],
+                 max_batch_size: int = 8,
+                 batch_buckets: Optional[Sequence[int]] = None):
+        self.name = name
+        self.apply_fn = apply_fn
+        self.params = params
+        self.inputs = list(inputs)
+        self.outputs = list(outputs)
+        self.max_batch_size = max_batch_size
+        self.batch_buckets = sorted(batch_buckets or default_batch_buckets(max_batch_size))
+        if self.batch_buckets[-1] != max_batch_size:
+            raise ValueError("largest bucket must equal max_batch_size")
+        self._bindings = {s.name: s for s in [*self.inputs, *self.outputs]}
+
+    # -- introspection (reference model.cc binding queries) -----------------
+    def binding(self, name: str) -> IOSpec:
+        return self._bindings[name]
+
+    @property
+    def binding_names(self) -> List[str]:
+        return list(self._bindings)
+
+    def is_input(self, name: str) -> bool:
+        return any(s.name == name for s in self.inputs)
+
+    def binding_size_in_bytes(self, name: str, batch_size: int) -> int:
+        return self.binding(name).bytes_per_sample() * batch_size
+
+    def element_count(self, name: str, batch_size: int) -> int:
+        return self.binding(name).elements_per_sample() * batch_size
+
+    def bindings_size_in_bytes(self, batch_size: Optional[int] = None) -> int:
+        """Total bytes of all bindings at a batch size (pool sizing input,
+        reference inference_manager.cc:110-117)."""
+        b = batch_size or self.max_batch_size
+        return sum(self.binding_size_in_bytes(n, b) for n in self._bindings)
+
+    def weights_size_in_bytes(self) -> int:
+        import jax
+        return sum(np.dtype(leaf.dtype).itemsize * int(math.prod(leaf.shape))
+                   for leaf in jax.tree_util.tree_leaves(self.params)
+                   if hasattr(leaf, "shape"))
+
+    def pick_bucket(self, batch_size: int) -> int:
+        """Smallest bucket >= batch_size (the 'profile selection')."""
+        if batch_size > self.max_batch_size:
+            raise ValueError(
+                f"batch {batch_size} exceeds max_batch_size {self.max_batch_size}")
+        for b in self.batch_buckets:
+            if b >= batch_size:
+                return b
+        raise AssertionError  # unreachable: last bucket == max
+
+    def __repr__(self) -> str:  # pragma: no cover
+        ins = ",".join(s.name for s in self.inputs)
+        outs = ",".join(s.name for s in self.outputs)
+        return (f"Model({self.name}, in=[{ins}], out=[{outs}], "
+                f"buckets={self.batch_buckets})")

@@ -1,0 +1,195 @@
+"""Runtime: compiles/loads models into per-bucket XLA executables
+(reference runtime.h:43-110, runtime.cc — deserialize_engine with logger
+bridge + weight capture).
+
+The TPU "engine artifact" (the TRT plan-file analog) is a directory:
+
+    <path>/spec.json            IO contract, buckets, model name
+    <path>/params.npz           weight leaves (flattened pytree)
+    <path>/treedef.txt          pytree structure
+    <path>/bucket_<N>.xla       serialized compiled executable (optional,
+                                topology-specific; recompiled if unusable)
+    <path>/stablehlo_<N>.mlir   portable StableHLO text per bucket
+
+``CompiledModel`` owns the per-bucket compiled programs for one device — the
+compiled program *is* the cudaGraph analog: one pre-compiled dispatch per
+bucket, no per-call graph building.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from tpulab.engine.model import IOSpec, Model
+from tpulab.tpu import platform as plat
+
+log = logging.getLogger("tpulab.engine")
+
+
+class CompiledModel:
+    """Per-device compiled executables, one per batch bucket."""
+
+    def __init__(self, model: Model, device, executables: Dict[int, Any],
+                 device_params: Any):
+        self.model = model
+        self.device = device
+        self.executables = executables      # bucket -> jax Compiled
+        self.device_params = device_params  # params resident on `device`
+
+    def memory_analysis(self, bucket: Optional[int] = None):
+        """Activation/scratch sizing (the TRT getDeviceMemorySize analog)."""
+        b = bucket or self.model.batch_buckets[-1]
+        try:
+            return self.executables[b].memory_analysis()
+        except Exception:  # backend may not support it (CPU tests)
+            return None
+
+    def activation_size_in_bytes(self) -> int:
+        ma = self.memory_analysis()
+        if ma is None:
+            return 0
+        return int(getattr(ma, "temp_size_in_bytes", 0) +
+                   getattr(ma, "output_size_in_bytes", 0))
+
+    def __call__(self, bucket: int, inputs: Dict[str, Any]) -> Dict[str, Any]:
+        return self.executables[bucket](self.device_params, inputs)
+
+
+class Runtime:
+    """Model compiler/loader (reference Runtime/CustomRuntime).
+
+    The reference's allocator-capture trick (ManagedRuntime unified-memory
+    weights) has no PjRt analog — weights live in HBM owned by the runtime;
+    HBM headroom is tracked via DeviceInfo.memory_info instead (SURVEY §7
+    risk note).
+    """
+
+    def __init__(self, device=None):
+        self.device = device if device is not None else plat.local_device(0)
+
+    # -- compile ------------------------------------------------------------
+    def compile_model(self, model: Model, buckets: Optional[Sequence[int]] = None,
+                      donate_params: bool = False) -> CompiledModel:
+        """JIT-compile one executable per batch bucket (AOT, warmed)."""
+        import jax
+
+        buckets = sorted(buckets or model.batch_buckets)
+        device_params = jax.device_put(model.params, self.device)
+
+        def call(params, inputs):
+            return model.apply_fn(params, inputs)
+
+        # Pin the lowering to this Runtime's device: without explicit
+        # shardings AOT executables bind to the default device and reject
+        # arguments committed elsewhere (multi-chip managers).
+        from jax.sharding import SingleDeviceSharding
+        dev_sharding = SingleDeviceSharding(self.device)
+        executables: Dict[int, Any] = {}
+        for b in buckets:
+            dummy = {
+                s.name: jax.ShapeDtypeStruct(s.batched_shape(b), s.np_dtype,
+                                             sharding=dev_sharding)
+                for s in model.inputs
+            }
+            pspec = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                               sharding=dev_sharding),
+                device_params)
+            lowered = jax.jit(call).lower(pspec, dummy)
+            executables[b] = lowered.compile()
+            log.info("compiled %s bucket=%d", model.name, b)
+        return CompiledModel(model, self.device, executables, device_params)
+
+    # -- engine artifacts ----------------------------------------------------
+    def save_engine(self, compiled: CompiledModel, path: str) -> None:
+        """Serialize an engine artifact (the TRT plan-file analog)."""
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        model = compiled.model
+        spec = {
+            "name": model.name,
+            "max_batch_size": model.max_batch_size,
+            "batch_buckets": model.batch_buckets,
+            "inputs": [[s.name, list(s.shape), np.dtype(s.dtype).name]
+                       for s in model.inputs],
+            "outputs": [[s.name, list(s.shape), np.dtype(s.dtype).name]
+                        for s in model.outputs],
+        }
+        with open(os.path.join(path, "spec.json"), "w") as f:
+            json.dump(spec, f, indent=2)
+        leaves, treedef = jax.tree_util.tree_flatten(model.params)
+        np.savez(os.path.join(path, "params.npz"),
+                 **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
+        with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+            pickle.dump(jax.tree_util.tree_structure(model.params), f)
+        for b, exe in compiled.executables.items():
+            try:
+                from jax.experimental import serialize_executable as se
+                blob, in_tree, out_tree = se.serialize(exe)
+                with open(os.path.join(path, f"bucket_{b}.xla"), "wb") as f:
+                    pickle.dump((blob, in_tree, out_tree), f)
+            except Exception as e:  # serialization is an optimization only
+                log.warning("executable serialization unavailable (%s); "
+                            "artifact will recompile on load", e)
+
+    def load_engine(self, path: str,
+                    apply_fn=None, model_name: Optional[str] = None) -> CompiledModel:
+        """Load an engine artifact; reuses serialized executables when the
+        topology matches, else recompiles from ``apply_fn``
+        (reference deserialize_engine, runtime.cc:62-95)."""
+        import jax
+
+        with open(os.path.join(path, "spec.json")) as f:
+            spec = json.load(f)
+        data = np.load(os.path.join(path, "params.npz"))
+        leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+        with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+            treedef = pickle.load(f)
+        params = jax.tree_util.tree_unflatten(treedef, leaves)
+        inputs = [IOSpec(n, tuple(s), np.dtype(d)) for n, s, d in spec["inputs"]]
+        outputs = [IOSpec(n, tuple(s), np.dtype(d)) for n, s, d in spec["outputs"]]
+        if apply_fn is None:
+            raise ValueError(
+                "load_engine requires apply_fn (the program source); engine "
+                "artifacts carry weights + IO contract + compiled programs")
+        model = Model(model_name or spec["name"], apply_fn, params,
+                      inputs, outputs, spec["max_batch_size"],
+                      spec["batch_buckets"])
+        device_params = jax.device_put(params, self.device)
+        executables: Dict[int, Any] = {}
+        for b in model.batch_buckets:
+            blob_path = os.path.join(path, f"bucket_{b}.xla")
+            if os.path.exists(blob_path):
+                try:
+                    from jax.experimental import serialize_executable as se
+                    with open(blob_path, "rb") as f:
+                        blob, in_tree, out_tree = pickle.load(f)
+                    exe = se.deserialize_and_load(blob, in_tree, out_tree)
+                    # smoke-validate: serialized executables are topology- and
+                    # machine-specific (the TRT plan-file caveat, sharper on
+                    # XLA); recompile when the artifact doesn't match here
+                    dummy = {
+                        s.name: np.zeros(s.batched_shape(b), s.np_dtype)
+                        for s in model.inputs
+                    }
+                    exe(device_params, dummy)
+                    executables[b] = exe
+                    continue
+                except Exception as e:
+                    log.warning("serialized executable for bucket %d unusable "
+                                "on this topology (%s); recompiling", b,
+                                type(e).__name__)
+            executables[b] = None
+        if any(v is None for v in executables.values()):
+            compiled = self.compile_model(
+                model, [b for b, v in executables.items() if v is None])
+            for b, exe in compiled.executables.items():
+                executables[b] = exe
+        return CompiledModel(model, self.device, executables, device_params)

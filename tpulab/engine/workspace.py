@@ -1,0 +1,122 @@
+"""Workspaces: pre-bound single-model execution
+(reference workspace.h:29-106, workspace.cc:44-164).
+
+- ``StaticSingleModelGraphWorkspace`` — everything pre-bound and warmed; each
+  ``enqueue()`` is a single pre-compiled dispatch.  The reference captures
+  enqueueV2 into a cudaGraph to erase launch overhead (workspace.cc:61-76);
+  XLA's compiled program plays that role natively: the whole model is one
+  fused graph, dispatched with one call.
+- ``BenchmarkWorkspace`` — adds pinned host mirrors + async H2D/D2H
+  (workspace.cc:90-124).
+- ``TimedBenchmarkWorkspace`` — per-stage timing of H2D / compute / D2H
+  (workspace.cc:126-164 cudaEvent timing -> monotonic timing around
+  blocking syncs).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+from tpulab.engine.model import Model
+from tpulab.engine.runtime import CompiledModel, Runtime
+from tpulab.tpu.copy import copy_to_device, copy_to_host
+from tpulab.tpu.sync import tpu_sync_standard
+
+
+class StaticSingleModelGraphWorkspace:
+    """Pre-bound, warmed, fixed-batch workspace (reference
+    StaticSingleModelGraphWorkspace)."""
+
+    def __init__(self, model: Model, batch_size: int = 0, device=None,
+                 compiled: CompiledModel = None):
+        self.batch_size = batch_size or model.max_batch_size
+        self.bucket = model.pick_bucket(self.batch_size)
+        self.model = model
+        self._compiled = compiled or Runtime(device).compile_model(
+            model, buckets=[self.bucket])
+        self.device = self._compiled.device
+        # pre-bound device inputs (the graph's fixed bindings)
+        self.device_inputs: Dict[str, Any] = {
+            s.name: copy_to_device(
+                np.zeros(s.batched_shape(self.bucket), s.np_dtype), self.device)
+            for s in model.inputs
+        }
+        self.device_outputs: Dict[str, Any] = {}
+        self.warmup()
+
+    def warmup(self) -> None:
+        """One throwaway dispatch (reference workspace.cc warmup before
+        graph capture)."""
+        out = self._compiled(self.bucket, self.device_inputs)
+        tpu_sync_standard(out)
+
+    def enqueue(self) -> Dict[str, Any]:
+        """Async dispatch on current device inputs (the graphLaunch analog)."""
+        self.device_outputs = self._compiled(self.bucket, self.device_inputs)
+        return self.device_outputs
+
+    def synchronize(self) -> None:
+        tpu_sync_standard(self.device_outputs)
+
+
+class BenchmarkWorkspace(StaticSingleModelGraphWorkspace):
+    """Adds pinned host mirrors + explicit async H2D/D2H
+    (reference BenchmarkWorkspace)."""
+
+    def __init__(self, model: Model, batch_size: int = 0, device=None,
+                 compiled: CompiledModel = None):
+        super().__init__(model, batch_size, device, compiled)
+        from tpulab.tpu.allocators import make_staging_allocator
+        from tpulab.memory.allocator import make_allocator
+        alloc = make_allocator(make_staging_allocator())
+        self._host_desc = []
+        self.host_inputs: Dict[str, np.ndarray] = {}
+        self.host_outputs: Dict[str, np.ndarray] = {}
+        for s in model.inputs:
+            d = alloc.allocate_descriptor(s.bytes_per_sample() * self.bucket)
+            self._host_desc.append(d)
+            self.host_inputs[s.name] = d.numpy(s.np_dtype,
+                                               s.batched_shape(self.bucket))
+        for s in model.outputs:
+            d = alloc.allocate_descriptor(s.bytes_per_sample() * self.bucket)
+            self._host_desc.append(d)
+            self.host_outputs[s.name] = d.numpy(s.np_dtype,
+                                                s.batched_shape(self.bucket))
+
+    def async_h2d(self) -> None:
+        for name, host in self.host_inputs.items():
+            self.device_inputs[name] = copy_to_device(host, self.device)
+
+    def async_d2h(self) -> None:
+        for name, dev in self.device_outputs.items():
+            if name in self.host_outputs:
+                copy_to_host(dev, self.host_outputs[name])
+
+    def run(self) -> None:
+        self.async_h2d()
+        self.enqueue()
+        self.async_d2h()
+
+
+class TimedBenchmarkWorkspace(BenchmarkWorkspace):
+    """Per-stage timings (reference TimedBenchmarkWorkspace cudaEvents)."""
+
+    def timed_run(self) -> Dict[str, float]:
+        t0 = time.perf_counter()
+        self.async_h2d()
+        tpu_sync_standard(self.device_inputs)
+        t1 = time.perf_counter()
+        self.enqueue()
+        tpu_sync_standard(self.device_outputs)
+        t2 = time.perf_counter()
+        self.async_d2h()
+        t3 = time.perf_counter()
+        return {
+            "h2d_ms": (t1 - t0) * 1e3,
+            "compute_ms": (t2 - t1) * 1e3,
+            "d2h_ms": (t3 - t2) * 1e3,
+            "total_ms": (t3 - t0) * 1e3,
+        }

@@ -1,0 +1,12 @@
+"""tpulab.models — the model zoo (reference models/ + examples/ONNX: ResNet-50
+/152 and MNIST engine-building assets, SURVEY §2.7).
+
+Models are defined in Flax and materialize as :class:`tpulab.engine.Model`
+objects via builders in :mod:`registry`; the engine layer compiles them per
+batch bucket.  bf16 compute is the default on TPU (MXU-native), float32 I/O at
+the binding boundary.
+"""
+
+from tpulab.models.registry import build_model, available_models
+
+__all__ = ["build_model", "available_models"]

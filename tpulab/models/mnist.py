@@ -1,0 +1,57 @@
+"""MNIST convnet (reference models/onnx/mnist-v1.3 — the quickstart/test
+model with golden test vectors)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_mnist_params(seed: int = 0) -> Dict[str, Any]:
+    k = jax.random.split(jax.random.PRNGKey(seed), 4)
+    return {
+        "conv1": {"kernel": jax.random.normal(k[0], (5, 5, 1, 8)) * 0.1,
+                  "bias": jnp.zeros((8,))},
+        "conv2": {"kernel": jax.random.normal(k[1], (5, 5, 8, 16)) * 0.1,
+                  "bias": jnp.zeros((16,))},
+        "fc": {"kernel": jax.random.normal(k[2], (7 * 7 * 16, 10)) * 0.05,
+               "bias": jnp.zeros((10,))},
+    }
+
+
+def mnist_apply(params: Dict[str, Any],
+                inputs: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """NHWC 28x28x1 image -> 10 logits (binding names mirror the onnx model:
+    Input3 -> Plus214_Output_0, reference pybind infer.cc MNIST usage)."""
+    x = inputs["Input3"]
+    maxpool = partial(jax.lax.reduce_window, init_value=-jnp.inf,
+                      computation=jax.lax.max,
+                      window_dimensions=(1, 2, 2, 1),
+                      window_strides=(1, 2, 2, 1),
+                      padding=[(0, 0), (0, 0), (0, 0), (0, 0)])
+    for layer in ("conv1", "conv2"):
+        x = jax.lax.conv_general_dilated(
+            x, params[layer]["kernel"], (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + params[layer]["bias"])
+        x = maxpool(x)
+    x = x.reshape((x.shape[0], -1))
+    logits = x @ params["fc"]["kernel"] + params["fc"]["bias"]
+    return {"Plus214_Output_0": logits}
+
+
+def make_mnist(max_batch_size: int = 8, seed: int = 0):
+    from tpulab.engine.model import IOSpec, Model
+
+    return Model(
+        name="mnist",
+        apply_fn=mnist_apply,
+        params=init_mnist_params(seed),
+        inputs=[IOSpec("Input3", (28, 28, 1), np.float32)],
+        outputs=[IOSpec("Plus214_Output_0", (10,), np.float32)],
+        max_batch_size=max_batch_size,
+    )

@@ -1,0 +1,43 @@
+"""Model registry: name -> builder (reference models/setup.py + onnx_builder
+downloading/building named engines)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+
+def _resnet(depth: int):
+    def build(**kw):
+        from tpulab.models.resnet import make_resnet
+        return make_resnet(depth=depth, **kw)
+    return build
+
+
+def _mnist(**kw):
+    from tpulab.models.mnist import make_mnist
+    return make_mnist(**kw)
+
+
+def _transformer(**kw):
+    from tpulab.models.transformer import make_transformer
+    return make_transformer(**kw)
+
+
+_REGISTRY: Dict[str, Callable] = {
+    "resnet50": _resnet(50),
+    "resnet101": _resnet(101),
+    "resnet152": _resnet(152),
+    "mnist": _mnist,
+    "transformer": _transformer,
+}
+
+
+def available_models() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def build_model(name: str, **kwargs):
+    """Build a servable Model by registry name."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; available: {available_models()}")
+    return _REGISTRY[name](**kwargs)

@@ -1,0 +1,162 @@
+"""ResNet v1.5 (50/101/152) in Flax — the serving flagship
+(reference models/ResNet-50/152 prototxt + examples/ONNX/resnet50 build
+pipeline; the benchmark model of BASELINE.md).
+
+TPU-first choices:
+- NHWC layout (XLA:TPU's native conv layout — channels on the 128-lane axis)
+- bf16 compute / f32 params ("mixed" policy): convs hit the MXU at full rate
+- inference-mode BatchNorm folded to scale+bias at build time (no batch_stats
+  plumbing in the serving path, same as TRT's BN folding)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+STAGE_SIZES = {
+    18: [2, 2, 2, 2],
+    34: [3, 4, 6, 3],
+    50: [3, 4, 6, 3],
+    101: [3, 4, 23, 3],
+    152: [3, 8, 36, 3],
+}
+
+
+def _conv_init(key, shape, dtype=jnp.float32):
+    fan_in = np.prod(shape[:-1])
+    std = np.sqrt(2.0 / fan_in)
+    return jax.random.normal(key, shape, dtype) * std
+
+
+def _init_conv_bn(key, kh, kw, cin, cout):
+    """One conv + folded-BN unit: returns params dict."""
+    kconv, _ = jax.random.split(key)
+    return {
+        "kernel": _conv_init(kconv, (kh, kw, cin, cout)),
+        # folded BN: y = scale * conv(x) + bias (identity at init)
+        "scale": jnp.ones((cout,), jnp.float32),
+        "bias": jnp.zeros((cout,), jnp.float32),
+    }
+
+
+def _conv_bn(params, x, stride=1, relu=True, compute_dtype=jnp.bfloat16):
+    kernel = params["kernel"].astype(compute_dtype)
+    y = jax.lax.conv_general_dilated(
+        x.astype(compute_dtype), kernel,
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    y = y * params["scale"].astype(compute_dtype) + params["bias"].astype(compute_dtype)
+    if relu:
+        y = jax.nn.relu(y)
+    return y
+
+
+def _init_bottleneck(key, cin, cmid, cout, stride):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    p = {
+        "conv1": _init_conv_bn(k1, 1, 1, cin, cmid),
+        "conv2": _init_conv_bn(k2, 3, 3, cmid, cmid),
+        "conv3": _init_conv_bn(k3, 1, 1, cmid, cout),
+    }
+    if stride != 1 or cin != cout:
+        p["proj"] = _init_conv_bn(k4, 1, 1, cin, cout)
+    return p
+
+
+def _bottleneck(params, x, stride, compute_dtype):
+    """v1.5 bottleneck: stride on the 3x3 conv."""
+    residual = x
+    y = _conv_bn(params["conv1"], x, 1, True, compute_dtype)
+    y = _conv_bn(params["conv2"], y, stride, True, compute_dtype)
+    y = _conv_bn(params["conv3"], y, 1, False, compute_dtype)
+    if "proj" in params:
+        residual = _conv_bn(params["proj"], x, stride, False, compute_dtype)
+    return jax.nn.relu(y + residual.astype(y.dtype))
+
+
+def init_resnet_params(depth: int = 50, num_classes: int = 1000,
+                       seed: int = 0) -> Dict[str, Any]:
+    """Random (He-init) weights; BN folded to identity scale/bias."""
+    if depth not in (50, 101, 152):
+        raise ValueError(f"unsupported ResNet depth {depth}")
+    rng = jax.random.PRNGKey(seed)
+    keys = iter(jax.random.split(rng, 64))
+    params: Dict[str, Any] = {"stem": _init_conv_bn(next(keys), 7, 7, 3, 64)}
+    cin = 64
+    for stage, blocks in enumerate(STAGE_SIZES[depth]):
+        cmid = 64 * (2 ** stage)
+        cout = cmid * 4
+        for block in range(blocks):
+            stride = 2 if (block == 0 and stage > 0) else 1
+            params[f"s{stage}b{block}"] = _init_bottleneck(
+                next(keys), cin, cmid, cout, stride)
+            cin = cout
+    kfc = next(keys)
+    params["fc"] = {
+        "kernel": jax.random.normal(kfc, (cin, num_classes)) * 0.01,
+        "bias": jnp.zeros((num_classes,)),
+    }
+    return params
+
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def resnet_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
+                 depth: int = 50, compute_dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+    """Forward pass: NHWC image -> logits (binding names: input / logits).
+
+    uint8 inputs are normalized on device ((x/255 - mean)/std in bf16) — the
+    parity path for the reference's INT8-input engines (examples/ONNX int8.py
+    calibrated pipeline): the wire/staging payload is 1 byte/pixel and all
+    arithmetic stays on the MXU-friendly dtype.
+    """
+    x = inputs["input"]
+    if x.dtype == jnp.uint8:
+        mean = jnp.asarray(IMAGENET_MEAN, compute_dtype) * 255.0
+        std = jnp.asarray(IMAGENET_STD, compute_dtype) * 255.0
+        x = (x.astype(compute_dtype) - mean) / std
+    y = _conv_bn(params["stem"], x, 2, True, compute_dtype)
+    y = jax.lax.reduce_window(
+        y, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+        [(0, 0), (1, 1), (1, 1), (0, 0)])
+    for stage, blocks in enumerate(STAGE_SIZES[depth]):
+        for block in range(blocks):
+            stride = 2 if (block == 0 and stage > 0) else 1
+            y = _bottleneck(params[f"s{stage}b{block}"], y, stride, compute_dtype)
+    y = jnp.mean(y, axis=(1, 2))  # global average pool
+    logits = (y.astype(jnp.float32) @ params["fc"]["kernel"]
+              + params["fc"]["bias"])
+    return {"logits": logits}
+
+
+def make_resnet(depth: int = 50, num_classes: int = 1000,
+                image_size: int = 224, max_batch_size: int = 8,
+                compute_dtype=jnp.bfloat16, seed: int = 0,
+                input_dtype=np.float32, batch_buckets=None):
+    """Build a servable ResNet Model.
+
+    ``input_dtype=np.uint8`` selects the INT8-parity serving path: raw pixel
+    bytes in, on-device normalization (4x less ingress bandwidth).
+    """
+    from tpulab.engine.model import IOSpec, Model
+
+    params = init_resnet_params(depth, num_classes, seed)
+    apply_fn = partial(resnet_apply, depth=depth, compute_dtype=compute_dtype)
+    return Model(
+        name=f"resnet{depth}",
+        apply_fn=apply_fn,
+        params=params,
+        inputs=[IOSpec("input", (image_size, image_size, 3), input_dtype)],
+        outputs=[IOSpec("logits", (num_classes,), np.float32)],
+        max_batch_size=max_batch_size,
+        batch_buckets=batch_buckets,
+    )

@@ -1,0 +1,102 @@
+"""Decoder-only transformer — the long-context model family.
+
+Not present in the reference (trtlab predates LLM serving — SURVEY §2.8 scope
+note); included because the TPU build treats long-context/sequence scaling as
+first-class.  The attention op is pluggable so the parallel layer can swap in
+ring attention (:mod:`tpulab.parallel.ring_attention`) for sequence lengths
+that exceed one chip's HBM.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_transformer_params(vocab: int = 32000, d_model: int = 512,
+                            n_heads: int = 8, n_layers: int = 6,
+                            d_ff: int = 2048, seed: int = 0) -> Dict[str, Any]:
+    rng = jax.random.PRNGKey(seed)
+    keys = iter(jax.random.split(rng, 4 * n_layers + 4))
+    s = 0.02
+    params: Dict[str, Any] = {
+        "embed": jax.random.normal(next(keys), (vocab, d_model)) * s,
+        "final_norm": {"scale": jnp.ones((d_model,))},
+    }
+    for i in range(n_layers):
+        params[f"layer{i}"] = {
+            "ln1": {"scale": jnp.ones((d_model,))},
+            "ln2": {"scale": jnp.ones((d_model,))},
+            "wqkv": jax.random.normal(next(keys), (d_model, 3 * d_model)) * s,
+            "wo": jax.random.normal(next(keys), (d_model, d_model)) * s,
+            "w1": jax.random.normal(next(keys), (d_model, d_ff)) * s,
+            "w2": jax.random.normal(next(keys), (d_ff, d_model)) * s,
+        }
+    return params
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def causal_attention(q, k, v):
+    """Default single-device causal attention (B, T, H, D)."""
+    b, t, h, d = q.shape
+    scores = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(d)
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def transformer_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
+                      n_heads: int = 8, n_layers: int = 6,
+                      compute_dtype=jnp.bfloat16,
+                      attention_fn: Callable = causal_attention
+                      ) -> Dict[str, jnp.ndarray]:
+    """tokens (B, T) int32 -> logits (B, T, vocab) f32."""
+    tokens = inputs["tokens"]
+    emb = params["embed"].astype(compute_dtype)
+    x = emb[tokens]
+    b, t, d_model = x.shape
+    head_dim = d_model // n_heads
+    for i in range(n_layers):
+        p = params[f"layer{i}"]
+        h = _rmsnorm(x, p["ln1"]["scale"])
+        qkv = h @ p["wqkv"].astype(compute_dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, n_heads, head_dim)
+        k = k.reshape(b, t, n_heads, head_dim)
+        v = v.reshape(b, t, n_heads, head_dim)
+        attn = attention_fn(q, k, v).reshape(b, t, d_model)
+        x = x + attn @ p["wo"].astype(compute_dtype)
+        h = _rmsnorm(x, p["ln2"]["scale"])
+        ff = jax.nn.gelu(h @ p["w1"].astype(compute_dtype))
+        x = x + ff @ p["w2"].astype(compute_dtype)
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    return {"logits": logits}
+
+
+def make_transformer(vocab: int = 32000, d_model: int = 512, n_heads: int = 8,
+                     n_layers: int = 6, d_ff: int = 2048, seq_len: int = 1024,
+                     max_batch_size: int = 4, compute_dtype=jnp.bfloat16,
+                     seed: int = 0, attention_fn: Callable = causal_attention):
+    from tpulab.engine.model import IOSpec, Model
+
+    params = init_transformer_params(vocab, d_model, n_heads, n_layers, d_ff, seed)
+    apply_fn = partial(transformer_apply, n_heads=n_heads, n_layers=n_layers,
+                       compute_dtype=compute_dtype, attention_fn=attention_fn)
+    return Model(
+        name="transformer",
+        apply_fn=apply_fn,
+        params=params,
+        inputs=[IOSpec("tokens", (seq_len,), np.int32)],
+        outputs=[IOSpec("logits", (seq_len, vocab), np.float32)],
+        max_batch_size=max_batch_size,
+    )

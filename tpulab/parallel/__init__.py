@@ -1,0 +1,33 @@
+"""tpulab.parallel — meshes, shardings, and multi-chip execution.
+
+The reference's parallelism axes (SURVEY §2.8) rebuilt TPU-native, plus the
+axes the reference predates (tensor/sequence parallelism, ring attention) —
+first-class here because multi-chip scaling shapes the core design:
+
+- :mod:`mesh` — device mesh construction (``data``/``model`` axes by default)
+- :mod:`sharding` — NamedSharding helpers + transformer partition rules
+  (megatron-style tp: qkv/ff column-parallel, proj row-parallel)
+- :mod:`dispatch` — per-chip resource bundles + round-robin multi-device
+  dispatch (SURVEY §2.8 axis 7: data-parallel pod serving)
+- :mod:`ring_attention` — sequence-parallel blockwise attention over
+  ``ppermute`` (long-context inference; the ICI-ring analog of the
+  reference's cyclic windowed streaming)
+- :mod:`training` — sharded train step (dp batch + tp params) used by the
+  multi-chip dry run
+"""
+
+from tpulab.parallel.mesh import make_mesh, default_mesh
+from tpulab.parallel.sharding import (
+    named_sharding,
+    replicate,
+    shard_batch,
+    transformer_param_shardings,
+)
+from tpulab.parallel.dispatch import MultiDeviceDispatcher
+
+__all__ = [
+    "make_mesh", "default_mesh",
+    "named_sharding", "replicate", "shard_batch",
+    "transformer_param_shardings",
+    "MultiDeviceDispatcher",
+]

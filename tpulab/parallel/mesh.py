@@ -1,0 +1,39 @@
+"""Device mesh construction.
+
+Mesh axes follow the scaling-book convention: ``data`` (DP, outermost,
+DCN-friendly), ``model`` (TP, innermost, rides ICI).  Sequence parallelism
+reuses the ``model`` axis unless a dedicated ``seq`` axis is requested.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None):
+    """Build a Mesh with the given axis sizes, e.g. {"data": 2, "model": 4}.
+
+    Axis order in the dict is the device-grid order: later axes are
+    innermost (most-local, fastest ICI hops on real slices).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    total = int(np.prod(list(axes.values())))
+    if total > len(devs):
+        raise ValueError(f"mesh needs {total} devices, have {len(devs)}")
+    grid = np.asarray(devs[:total]).reshape(tuple(axes.values()))
+    return Mesh(grid, tuple(axes))
+
+
+def default_mesh(n_model: int = 1, devices: Optional[Sequence] = None):
+    """All devices: data-parallel outer, model-parallel inner."""
+    import jax
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if n % n_model:
+        raise ValueError(f"{n} devices not divisible by model={n_model}")
+    return make_mesh({"data": n // n_model, "model": n_model}, devs)

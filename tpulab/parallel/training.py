@@ -1,0 +1,59 @@
+"""Sharded training step (dp batch x tp params) for the transformer family.
+
+The reference is inference-only; the TPU build carries a real multi-chip
+training step so serving deployments can fine-tune/calibrate in place and so
+the multi-chip path (mesh + shardings + collectives) is exercised end to end
+(it also backs ``__graft_entry__.dryrun_multichip``).
+
+Design: pure jax.jit over a Mesh — params carry megatron TP shardings
+(:func:`tpulab.parallel.sharding.transformer_param_shardings`), the batch is
+sharded over ``data``; XLA inserts the psums (gradient reduction over data,
+row-parallel matmul reductions over model).  No hand-written collectives.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpulab.parallel.sharding import (shard_batch, replicate,
+                                      transformer_param_shardings)
+
+
+def cross_entropy_loss(apply_fn: Callable, params: Any,
+                       batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """Next-token cross entropy over the transformer's logits."""
+    logits = apply_fn(params, {"tokens": batch["tokens"]})["logits"]
+    targets = batch["targets"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_sharded_train_step(apply_fn: Callable, params: Any, mesh,
+                            learning_rate: float = 1e-3):
+    """Returns (jitted_step, sharded_params).
+
+    ``jitted_step(params, batch) -> (params, loss)`` — SGD, donated params.
+    """
+    param_shardings = transformer_param_shardings(params, mesh)
+    batch_shardings = {"tokens": shard_batch(mesh), "targets": shard_batch(mesh)}
+    sharded_params = jax.device_put(params, param_shardings)
+
+    def step(p, batch):
+        loss, grads = jax.value_and_grad(
+            lambda q: cross_entropy_loss(apply_fn, q, batch))(p)
+        new_p = jax.tree_util.tree_map(
+            lambda w, g: (w - learning_rate * g).astype(w.dtype), p, grads)
+        return new_p, loss
+
+    jitted = jax.jit(
+        step,
+        in_shardings=(param_shardings, batch_shardings),
+        out_shardings=(param_shardings, replicate(mesh)),
+        donate_argnums=(0,),
+    )
+    return jitted, sharded_params

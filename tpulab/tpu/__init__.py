@@ -1,0 +1,42 @@
+"""tpulab.tpu — the device layer (reference trtlab/cuda, §2.3 of SURVEY.md).
+
+Everything device-specific lives here, layered on JAX/PjRt the way the
+reference layers on the CUDA runtime:
+
+- :mod:`platform` — client bootstrap + device handles (no global state; a
+  ``device_guard`` is unnecessary on TPU, reference device_guard.h is a no-op
+  here by design)
+- :mod:`device_info` — topology/HBM introspection (reference device_info.h
+  NVML queries → PjRt device attributes + memory_stats)
+- :mod:`memory_types` — ``TpuMemory`` (HBM) and ``HostPinnedMemory`` staging
+  kinds (reference device_memory.h:36-84)
+- :mod:`allocators` — RawAllocator over HBM device buffers +
+  ``make_tpu_allocator`` (reference cuda_allocators.h:44-183)
+- :mod:`sync` — ``tpu_sync`` event polling: blocking for OS threads, yielding
+  for event-loop handlers (reference sync.h:27-62 cuda_sync<ThreadType>)
+- :mod:`copy` — typed host<->HBM copies (reference src/copy.cc:41-70)
+- :mod:`cyclic_buffer` — device windowed stack (reference
+  cuda/cyclic_windowed_buffer.h:27-44)
+"""
+
+from tpulab.tpu.platform import (
+    devices,
+    local_device,
+    device_count,
+    platform_name,
+    is_tpu,
+)
+from tpulab.tpu.device_info import DeviceInfo
+from tpulab.tpu.memory_types import TpuMemory, HostPinnedMemory, make_tpu_memory_type
+from tpulab.tpu.allocators import TpuRawAllocator, make_tpu_allocator, make_staging_allocator
+from tpulab.tpu.sync import tpu_sync_standard, tpu_sync_async, TpuSync
+from tpulab.tpu.copy import copy_to_device, copy_to_host, copy_device_to_device
+
+__all__ = [
+    "devices", "local_device", "device_count", "platform_name", "is_tpu",
+    "DeviceInfo",
+    "TpuMemory", "HostPinnedMemory", "make_tpu_memory_type",
+    "TpuRawAllocator", "make_tpu_allocator", "make_staging_allocator",
+    "tpu_sync_standard", "tpu_sync_async", "TpuSync",
+    "copy_to_device", "copy_to_host", "copy_device_to_device",
+]

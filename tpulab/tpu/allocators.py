@@ -1,0 +1,114 @@
+"""Device + staging allocators (reference cuda_allocators.h:44-183).
+
+``TpuRawAllocator`` satisfies the framework's RawAllocator concept over HBM:
+``allocate_node(size)`` materializes a zeroed uint8 device buffer on its bound
+device and returns a synthetic address (PjRt owns the real pointers; the
+address keys the framework's arenas/descriptors while ``block_handle``/
+``device_buffer`` carries the JAX array).  The whole block/arena/transactional
+stack from :mod:`tpulab.memory` composes over it unchanged — exactly how the
+reference's device allocators slot under its arenas.
+
+``make_tpu_allocator(device)`` mirrors the reference's
+``make_cuda_allocator(device_id)`` (stateful allocator bound to a device).
+``make_staging_allocator()`` builds the pinned-host staging allocator
+(page-aligned, first-touch).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from tpulab.memory.debugging import InvalidPointer, OutOfMemory
+from tpulab.memory.memory_type import MemoryType
+from tpulab.memory.raw_allocators import FirstTouchAllocator
+from tpulab.tpu.memory_types import HostPinnedMemory, TpuMemory
+from tpulab.tpu import platform as plat
+
+# Synthetic HBM "addresses": high bit pattern avoids colliding with host
+# pointers; stride leaves room for offset arithmetic within a block.
+_TPU_ADDR_BASE = 1 << 60
+_TPU_ADDR_STRIDE = 1 << 40  # 1 TiB per block — offsets stay inside the block
+
+
+class TpuRawAllocator:
+    """RawAllocator over HBM buffers for one device
+    (reference device_allocator binding a device id)."""
+
+    is_stateful = True
+
+    def __init__(self, device=None):
+        import jax
+        self._jax = jax
+        self.device = device if device is not None else plat.local_device(0)
+        self.memory_type: MemoryType = TpuMemory
+        self._lock = threading.Lock()
+        self._next = itertools.count()
+        #: addr -> jax.Array (the live HBM buffer)
+        self._buffers: Dict[int, object] = {}
+
+    def allocate_node(self, size: int, alignment: int = 0) -> int:
+        if size <= 0:
+            raise OutOfMemory("TpuRawAllocator", size, "(non-positive size)")
+        jnp = self._jax.numpy
+        try:
+            buf = self._jax.device_put(
+                jnp.zeros((size,), dtype=jnp.uint8), self.device)
+        except Exception as e:  # surface HBM exhaustion as the framework type
+            raise OutOfMemory("TpuRawAllocator", size, str(e)) from e
+        with self._lock:
+            addr = _TPU_ADDR_BASE + next(self._next) * _TPU_ADDR_STRIDE
+            self._buffers[addr] = buf
+        return addr
+
+    def deallocate_node(self, addr: int, size: int = 0, alignment: int = 0) -> None:
+        with self._lock:
+            buf = self._buffers.pop(addr, None)
+        if buf is None:
+            raise InvalidPointer(f"0x{addr:x} not an HBM block of this allocator")
+        buf.delete()  # eagerly free HBM rather than waiting for GC
+
+    def buffer(self, addr: int):
+        """The JAX array backing a block address."""
+        with self._lock:
+            base = _TPU_ADDR_BASE + ((addr - _TPU_ADDR_BASE) // _TPU_ADDR_STRIDE) * _TPU_ADDR_STRIDE
+            buf = self._buffers.get(base)
+        if buf is None:
+            raise InvalidPointer(f"0x{addr:x} not in any live HBM block")
+        return buf
+
+    @property
+    def live_allocations(self) -> int:
+        with self._lock:
+            return len(self._buffers)
+
+    def max_node_size(self) -> int:
+        return _TPU_ADDR_STRIDE
+
+    def max_alignment(self) -> int:
+        return TpuMemory.access_alignment
+
+
+def make_tpu_allocator(device=None) -> TpuRawAllocator:
+    """Reference ``make_cuda_allocator(device_id)``."""
+    return TpuRawAllocator(device)
+
+
+class PinnedStagingAllocator(FirstTouchAllocator):
+    """Pinned-host staging allocator: page-aligned mmap, first-touch fill
+    (reference cuda_malloc_host)."""
+
+    def __init__(self):
+        super().__init__(fill=0)
+        self.memory_type = HostPinnedMemory
+
+    def allocate_node(self, size: int, alignment: int = 0) -> int:
+        return super().allocate_node(
+            size, max(alignment, HostPinnedMemory.min_allocation_alignment))
+
+
+def make_staging_allocator() -> PinnedStagingAllocator:
+    return PinnedStagingAllocator()

@@ -1,0 +1,43 @@
+"""Typed host<->HBM copies (reference src/copy.cc:41-70 — Copy() dispatching
+on memory kinds over cudaMemcpyDefault).
+
+All device transfers are *asynchronous dispatches*: JAX returns immediately
+and the arrays carry their own readiness (sync via :mod:`tpulab.tpu.sync`).
+That is the TPU analog of cudaMemcpyAsync on the buffers' stream.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def copy_to_device(host_array: np.ndarray, device=None, donate: bool = False):
+    """Host -> HBM (reference H2D path; PJRT_Client_BufferFromHostBuffer).
+
+    ``host_array`` should come from pinned staging (page-aligned descriptor
+    views) for peak DMA throughput.  Returns immediately.
+    """
+    if device is not None:
+        return jax.device_put(host_array, device)
+    return jax.device_put(host_array)
+
+
+def copy_to_host(device_array, out: Optional[np.ndarray] = None) -> np.ndarray:
+    """HBM -> host (reference D2H; PJRT_Buffer_ToHostBuffer).
+
+    With ``out`` (a staging view) the transfer lands in caller-owned memory.
+    Blocks until the transfer completes.
+    """
+    host = np.asarray(device_array)
+    if out is not None:
+        np.copyto(out, host)
+        return out
+    return host
+
+
+def copy_device_to_device(device_array, device):
+    """HBM -> HBM across chips (reference D2D; ICI transfer via PjRt)."""
+    return jax.device_put(device_array, device)

@@ -1,0 +1,63 @@
+"""Device specialization of the cyclic windowed stack
+(reference cuda/cyclic_windowed_buffer.h:27-44: device stack whose window
+copies/replication run as cudaMemcpyAsync + stream sync).
+
+``TpuCyclicWindowedStack`` keeps the cyclic geometry and backpressure of the
+host version but each completed window is shipped to the device as an async
+transfer; the window's sync function is the device array's readiness.  The
+compute callback receives the *device* array — ready to feed a jitted program
+— so streaming sequence chunks flow host->HBM->compute with bounded memory.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Callable, Optional
+
+import numpy as np
+
+from tpulab.core.cyclic_buffer import CyclicWindowedStack
+from tpulab.core.thread_pool import ThreadPool
+from tpulab.memory.descriptor import Descriptor
+from tpulab.tpu.copy import copy_to_device
+
+
+class TpuCyclicWindowedStack(CyclicWindowedStack):
+    """Windowed streaming into HBM (reference cuda cyclic_windowed_stack)."""
+
+    def __init__(self, buffer: Descriptor, window_count: int, window_size: int,
+                 overlap: int = 0, device=None,
+                 compute_fn: Optional[Callable[[int, object], object]] = None,
+                 dtype=np.uint8,
+                 executor: Optional[ThreadPool] = None):
+        """``compute_fn(window_id, device_array)`` runs per filled window; its
+        return (a JAX tree) is synced before the window slot is reused."""
+        super().__init__(buffer, window_count, window_size, overlap,
+                         on_window=self._ship_window)
+        self.device = device
+        self._compute_fn = compute_fn
+        self._dtype = np.dtype(dtype)
+        self._executor = executor
+
+    def _ship_window(self, win_id: int, view: memoryview) -> Optional[Future]:
+        host = np.frombuffer(view, dtype=self._dtype)
+        if self._executor is not None:
+            return self._executor.enqueue(self._window_task, win_id, host)
+        fut: Future = Future()
+        try:
+            fut.set_result(self._window_task(win_id, host))
+        except BaseException as e:  # noqa: BLE001
+            fut.set_exception(e)
+        return fut
+
+    def _window_task(self, win_id: int, host: np.ndarray):
+        dev = copy_to_device(host, self.device)          # async H2D
+        if self._compute_fn is not None:
+            out = self._compute_fn(win_id, dev)          # async dispatch
+        else:
+            out = dev
+        import jax
+        for leaf in jax.tree_util.tree_leaves(out):
+            if hasattr(leaf, "block_until_ready"):
+                leaf.block_until_ready()                 # stream sync analog
+        return out

@@ -1,0 +1,42 @@
+"""Device memory kinds (reference cuda device_memory.h:36-84).
+
+- ``TpuMemory`` — HBM on a TPU chip, backed by JAX/PjRt buffers.  Not host
+  accessible; 512B access alignment (XLA tile row).
+- ``HostPinnedMemory`` — page-aligned host staging memory used for fast
+  host->HBM transfer (the kDLCPUPinned analog; on TPU "pinned" means
+  page-aligned + first-touched on the host's NUMA node so DMA from the
+  transfer engines streams without faults).
+"""
+
+from __future__ import annotations
+
+from tpulab.memory.memory_type import DLDeviceType, MemoryType
+
+#: HBM device memory (reference device_memory: kDLGPU, 256B/64B align).
+TpuMemory = MemoryType(
+    name="tpu",
+    device_type=DLDeviceType.kDLTPU,
+    min_allocation_alignment=512,
+    access_alignment=512,
+    host_accessible=False,
+)
+
+#: Staging host memory (reference host_pinned_memory: kDLCPUPinned).
+HostPinnedMemory = MemoryType(
+    name="host_pinned",
+    device_type=DLDeviceType.kDLCUDAHost,  # DLPack's pinned-host code
+    min_allocation_alignment=4096,
+    access_alignment=64,
+    host_accessible=True,
+)
+
+
+def make_tpu_memory_type(device_id: int) -> MemoryType:
+    """A per-device memory kind, for multi-chip resource bundles."""
+    return MemoryType(
+        name=f"tpu:{device_id}",
+        device_type=DLDeviceType.kDLTPU,
+        min_allocation_alignment=512,
+        access_alignment=512,
+        host_accessible=False,
+    )

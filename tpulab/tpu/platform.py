@@ -1,0 +1,68 @@
+"""PjRt client bootstrap + device handles.
+
+The analog of the reference's CUDA runtime initialization; on TPU there is no
+per-thread "current device" (reference device_guard.h) — device identity is
+carried explicitly by JAX device handles, which is why none of the framework's
+APIs have set-device side effects.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import List, Optional
+
+
+def _jax():
+    import jax
+    return jax
+
+
+@functools.lru_cache(maxsize=None)
+def devices(platform: Optional[str] = None) -> tuple:
+    """All addressable devices (reference DeviceInfo::Count enumeration)."""
+    return tuple(_jax().devices(platform) if platform else _jax().devices())
+
+
+def device_count() -> int:
+    return len(devices())
+
+
+def local_device(index: int = 0):
+    """A device handle by local index."""
+    devs = devices()
+    if index >= len(devs):
+        raise IndexError(f"device {index} out of range ({len(devs)} available)")
+    return devs[index]
+
+
+def platform_name() -> str:
+    return devices()[0].platform
+
+
+def is_tpu() -> bool:
+    return platform_name() == "tpu"
+
+
+def process_index() -> int:
+    """This host's index in a multi-host deployment."""
+    return _jax().process_index()
+
+
+def process_count() -> int:
+    return _jax().process_count()
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    """Hermetic-test hook: route JAX to N virtual CPU devices.
+
+    Must run before any JAX backend is created.  Uses the config API because
+    the JAX_PLATFORMS env var is ignored when an experimental TPU plugin
+    (e.g. 'axon') is installed.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    _jax().config.update("jax_platforms", "cpu")
+    devices.cache_clear()

@@ -1,0 +1,136 @@
+"""Fiber-friendly device synchronization (reference sync.h:27-62).
+
+The reference's key pattern: ``cuda_sync<userspace_threads>`` polls
+``cudaEventQuery`` and yields the fiber between polls so one OS thread keeps
+many requests in flight; ``cuda_sync<standard_threads>`` blocks.
+
+TPU mapping over JAX arrays (PjRt buffers):
+
+- ``tpu_sync_standard(x)`` — blocking ``block_until_ready`` (PJRT_Event_Await)
+- ``tpu_sync_async(x)`` — awaitable poll of ``is_ready()`` with event-loop
+  yields (PJRT_Event_IsReady + fiber yield); usable from AsyncDispatcher /
+  event-loop RPC handlers so the loop thread is never blocked.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Iterable
+
+import jax
+
+
+def _leaves(tree: Any) -> Iterable:
+    return jax.tree_util.tree_leaves(tree)
+
+
+def tpu_sync_standard(tree: Any) -> Any:
+    """Blocking sync (reference cuda_sync<standard_threads>::event_sync)."""
+    for leaf in _leaves(tree):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+    return tree
+
+
+async def tpu_sync_async(tree: Any, poll_s: float = 0.0) -> Any:
+    """Yielding sync (reference cuda_sync<userspace_threads>: poll + yield)."""
+    for leaf in _leaves(tree):
+        if hasattr(leaf, "is_ready"):
+            while not leaf.is_ready():
+                await asyncio.sleep(poll_s)
+    return tree
+
+
+class TpuSync:
+    """Policy object mirroring cuda_sync<ThreadType> selection."""
+
+    @staticmethod
+    def standard(tree: Any) -> Any:
+        return tpu_sync_standard(tree)
+
+    @staticmethod
+    def userspace(tree: Any, poll_s: float = 0.0):
+        return tpu_sync_async(tree, poll_s)
+
+
+class EventPoller:
+    """Central readiness poller — one thread watching many in-flight trees
+    (the reference's cuda_sync poll loop, centralized).
+
+    ``watch(tree, callback)`` fires ``callback()`` once every leaf reports
+    ``is_ready()``.  Used by the engine to recycle execution tokens the moment
+    *compute* finishes, independent of (much slower) D2H materialization —
+    mirroring the reference post stage's ctx->Synchronize(); ctx.reset()
+    before bindings->Synchronize() (infer_runner.h:93-102).
+
+    Callbacks run on the poller thread and must be tiny (pool pushes).
+    """
+
+    def __init__(self, interval_s: float = 0.0005, name: str = "event-poller"):
+        import collections
+        import threading
+        self._interval = interval_s
+        self._entries: "collections.deque" = collections.deque()
+        self._cv = threading.Condition()
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def watch(self, tree: Any, callback) -> None:
+        leaves = [l for l in _leaves(tree) if hasattr(l, "is_ready")]
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("EventPoller is shut down")
+            self._entries.append((leaves, callback))
+            self._cv.notify()
+
+    def _run(self) -> None:
+        import logging
+        import time
+        log = logging.getLogger("tpulab.tpu")
+        while True:
+            with self._cv:
+                while not self._entries and not self._shutdown:
+                    self._cv.wait()
+                if self._shutdown:
+                    pending = list(self._entries)
+                    self._entries.clear()
+                else:
+                    pending = None
+            if pending is not None:
+                for _leaves_, cb in pending:  # drain on shutdown
+                    self._fire(cb, log)
+                return
+            still_waiting = []
+            fired = 0
+            with self._cv:
+                entries = list(self._entries)
+                self._entries.clear()
+            for leaves, cb in entries:
+                try:
+                    ready = all(l.is_ready() for l in leaves)
+                except Exception:
+                    ready = True  # deleted/errored buffers count as done
+                if ready:
+                    self._fire(cb, log)
+                    fired += 1
+                else:
+                    still_waiting.append((leaves, cb))
+            if still_waiting:
+                with self._cv:
+                    self._entries.extendleft(reversed(still_waiting))
+            if not fired:
+                time.sleep(self._interval)
+
+    @staticmethod
+    def _fire(cb, log) -> None:
+        try:
+            cb()
+        except Exception:  # pragma: no cover
+            log.exception("EventPoller callback failed")
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify()
+        self._thread.join(timeout=10)
