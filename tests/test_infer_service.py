@@ -1,0 +1,74 @@
+"""End-to-end serving tests: local manager -> gRPC service -> remote client
+(reference examples/30_PyTensorRT server.py/client.py + the Multiple Models
+notebook flow, with golden numeric checks in the run_onnx_tests.py style)."""
+
+import numpy as np
+import pytest
+
+import tpulab
+from tpulab.models.mnist import make_mnist
+from tpulab.rpc.infer_service import RemoteInferenceManager
+
+
+@pytest.fixture(scope="module")
+def serving():
+    mgr = tpulab.InferenceManager(max_exec_concurrency=2)
+    mgr.register_model("mnist", make_mnist(max_batch_size=4))
+    mgr.update_resources()
+    mgr.serve(port=0)  # ephemeral port
+    port = mgr.server.bound_port
+    remote = RemoteInferenceManager(f"localhost:{port}")
+    yield mgr, remote
+    remote.close()
+    mgr.shutdown()
+
+
+def test_remote_model_listing(serving):
+    _mgr, remote = serving
+    models = remote.get_models()
+    assert "mnist" in models
+    ms = models["mnist"]
+    assert ms.max_batch_size == 4
+    assert [i.name for i in ms.inputs] == ["Input3"]
+    assert list(ms.batch_buckets) == [1, 2, 4]
+
+
+def test_remote_infer_matches_local(serving):
+    """Golden check: remote serving path == local pipeline numerically."""
+    mgr, remote = serving
+    x = np.random.default_rng(3).standard_normal((2, 28, 28, 1)).astype(np.float32)
+    runner = remote.infer_runner("mnist")
+    remote_out = runner.infer(Input3=x).result(timeout=60)
+    local_out = mgr.infer_runner("mnist").infer(Input3=x).result(timeout=60)
+    np.testing.assert_allclose(remote_out["Plus214_Output_0"],
+                               local_out["Plus214_Output_0"], rtol=1e-5)
+
+
+def test_remote_concurrent_requests(serving):
+    _mgr, remote = serving
+    runner = remote.infer_runner("mnist")
+    x = np.zeros((1, 28, 28, 1), np.float32)
+    futs = [runner.infer(Input3=x) for _ in range(16)]
+    outs = [f.result(timeout=60) for f in futs]
+    assert all(o["Plus214_Output_0"].shape == (1, 10) for o in outs)
+
+
+def test_remote_unknown_model(serving):
+    _mgr, remote = serving
+    with pytest.raises(KeyError):
+        remote.infer_runner("nope")
+
+
+def test_remote_bad_dtype_is_clean_error(serving):
+    _mgr, remote = serving
+    runner = remote.infer_runner("mnist")
+    bad = np.zeros((1, 28, 28, 1), np.float64)  # wrong dtype
+    with pytest.raises(RuntimeError):
+        runner.infer(Input3=bad).result(timeout=60)
+
+
+def test_remote_binding_introspection(serving):
+    _mgr, remote = serving
+    runner = remote.infer_runner("mnist")
+    assert runner.input_bindings()["Input3"][0] == (28, 28, 1)
+    assert runner.output_bindings()["Plus214_Output_0"][1] == np.dtype(np.float32)

@@ -1,0 +1,299 @@
+"""RPC framework loopback tests (reference nvrpc/tests: test_pingpong.cc,
+test_server.cc — in-process integration over real localhost sockets,
+BuildServer/BuildStreamingServer fixtures with TestResources)."""
+
+import threading
+import time
+
+import pytest
+
+from tpulab.core.resources import Resources
+from tpulab.rpc import (AsyncService, BatchingContext, ClientExecutor,
+                        ClientStreaming, ClientUnary, Context, Executor,
+                        FiberExecutor, Server, StreamingContext)
+
+ECHO = "tpulab.testing.Echo"
+
+
+class EchoResources(Resources):
+    """Reference test_resources.h: shared bookkeeping bundle."""
+
+    def __init__(self):
+        self.counter = 0
+        self.lock = threading.Lock()
+
+    def bump(self):
+        with self.lock:
+            self.counter += 1
+            return self.counter
+
+
+class EchoContext(Context):
+    def execute_rpc(self, request: bytes) -> bytes:
+        self.get_resources(EchoResources).bump()
+        return b"pong:" + request
+
+
+class SlowContext(Context):
+    """Blocking wait — legal on thread executors (workers absorb it)."""
+
+    def execute_rpc(self, request: bytes) -> bytes:
+        time.sleep(0.05)
+        return b"slow:" + request
+
+
+class AsyncSlowContext(Context):
+    """Fiber-aware wait — the FiberExecutor overlap path.  A *blocking*
+    sleep would stall the loop thread, exactly as it stalls a fiber
+    scheduler thread in the reference."""
+
+    async def execute_rpc(self, request: bytes) -> bytes:
+        import asyncio
+        await asyncio.sleep(0.05)
+        return b"slow:" + request
+
+
+class StreamEchoContext(StreamingContext):
+    """Reference test_pingpong.h streaming context: echo each request."""
+
+    def on_request(self, request: bytes) -> None:
+        self.write(b"pong:" + request)
+
+    def on_requests_finished(self) -> None:
+        self.write(b"done")
+
+
+class SumBatchContext(BatchingContext):
+    max_batch_size = 4
+    batch_window_s = 0.05
+
+    def execute_batch(self, requests):
+        # each caller gets the batch size it rode in
+        n = str(len(requests)).encode()
+        return [n for _ in requests]
+
+
+def build_server(executor):
+    """Reference BuildServer fixture (localhost, pre-armed contexts)."""
+    res = EchoResources()
+    server = Server("127.0.0.1:0", executor)
+    svc = AsyncService(ECHO, res)
+    svc.register_rpc("Unary", EchoContext)
+    svc.register_rpc("Slow",
+                     SlowContext if not executor.is_fiber else AsyncSlowContext)
+    svc.register_rpc("Stream", StreamEchoContext)
+    svc.register_rpc("Batch", SumBatchContext)
+    server.register_async_service(svc)
+    server.async_start()
+    server.wait_until_running()
+    return server, res
+
+
+@pytest.fixture(params=["threads", "fiber"])
+def server(request):
+    executor = Executor(n_threads=4) if request.param == "threads" \
+        else FiberExecutor()
+    server, res = build_server(executor)
+    yield server, res
+    server.shutdown()
+
+
+def _client(server) -> ClientExecutor:
+    return ClientExecutor(f"127.0.0.1:{server.bound_port}")
+
+
+def test_unary_pingpong(server):
+    srv, res = server
+    with _client(srv) as cx:
+        unary = ClientUnary(cx, f"/{ECHO}/Unary")
+        assert unary.call(b"hello", timeout=10) == b"pong:hello"
+        futs = [unary.start(str(i).encode()) for i in range(20)]
+        outs = {f.result(timeout=10) for f in futs}
+        assert outs == {b"pong:" + str(i).encode() for i in range(20)}
+    assert res.counter == 21  # resources shared across contexts
+
+
+def test_unary_on_complete_callback(server):
+    srv, _ = server
+    with _client(srv) as cx:
+        unary = ClientUnary(cx, f"/{ECHO}/Unary")
+        fut = unary.start(b"x", on_complete=lambda resp: resp.decode().upper())
+        assert fut.result(timeout=10) == "PONG:X"
+
+
+def test_unary_concurrent_slow_requests(server):
+    """Handlers may block; concurrency must not collapse to serial."""
+    srv, _ = server
+    with _client(srv) as cx:
+        slow = ClientUnary(cx, f"/{ECHO}/Slow")
+        t0 = time.perf_counter()
+        futs = [slow.start(b"r") for _ in range(8)]
+        [f.result(timeout=10) for f in futs]
+        elapsed = time.perf_counter() - t0
+    assert elapsed < 8 * 0.05 * 0.9  # overlapped, not serialized
+
+
+def test_streaming_pingpong(server):
+    srv, _ = server
+    responses = []
+    with _client(srv) as cx:
+        stream = ClientStreaming(cx, f"/{ECHO}/Stream", responses.append)
+        for i in range(5):
+            stream.write(str(i).encode())
+        stream.writes_done()
+        stream.done().result(timeout=10)
+    assert responses == [b"pong:" + str(i).encode() for i in range(5)] + [b"done"]
+
+
+def test_streaming_early_cancel(server):
+    """Reference early-cancel context variant."""
+    srv, _ = server
+    responses = []
+    with _client(srv) as cx:
+        stream = ClientStreaming(cx, f"/{ECHO}/Stream", responses.append)
+        stream.write(b"one")
+        stream.cancel()
+        with pytest.raises(Exception):
+            stream.done().result(timeout=10)
+
+
+def test_batching_context_aggregates(server):
+    srv, _ = server
+    with _client(srv) as cx:
+        batch = ClientUnary(cx, f"/{ECHO}/Batch")
+        futs = [batch.start(b"x") for _ in range(4)]
+        sizes = [int(f.result(timeout=10)) for f in futs]
+    assert max(sizes) >= 2  # concurrent callers actually shared a batch
+
+
+def test_batching_window_timeout(server):
+    srv, _ = server
+    with _client(srv) as cx:
+        batch = ClientUnary(cx, f"/{ECHO}/Batch")
+        assert int(batch.call(b"x", timeout=10)) == 1  # window closed alone
+
+
+def test_server_shutdown_is_clean():
+    server, _ = build_server(Executor(n_threads=2))
+    port = server.bound_port
+    server.shutdown()
+    with ClientExecutor(f"127.0.0.1:{port}") as cx:
+        unary = ClientUnary(cx, f"/{ECHO}/Unary")
+        with pytest.raises(Exception):
+            unary.call(b"x", timeout=2)
+
+
+def test_fiber_async_contexts():
+    """Coroutine handlers awaiting pool resources (the fiber property)."""
+    import asyncio
+    from tpulab.core.pool import Pool
+
+    class PoolResources(Resources):
+        def __init__(self):
+            self.pool = Pool(["tok"])
+
+    class AsyncCtx(Context):
+        async def execute_rpc(self, request: bytes) -> bytes:
+            item = await self.get_resources(PoolResources).pool.pop_async()
+            try:
+                await asyncio.sleep(0.01)
+                return b"async:" + request
+            finally:
+                item.release()
+
+    res = PoolResources()
+    server = Server("127.0.0.1:0", FiberExecutor())
+    svc = AsyncService(ECHO, res)
+    svc.register_rpc("AUnary", AsyncCtx)
+    server.register_async_service(svc)
+    server.async_start()
+    server.wait_until_running()
+    try:
+        with ClientExecutor(f"127.0.0.1:{server.bound_port}") as cx:
+            unary = ClientUnary(cx, f"/{ECHO}/AUnary")
+            futs = [unary.start(str(i).encode()) for i in range(8)]
+            outs = [f.result(timeout=10) for f in futs]
+            assert all(o.startswith(b"async:") for o in outs)
+    finally:
+        server.shutdown()
+
+
+# -------------------------------------------- regression: review findings ---
+class FailingStreamContext(StreamingContext):
+    def on_request(self, request: bytes) -> None:
+        if request == b"boom":
+            raise RuntimeError("handler failure")
+        self.write(b"ok:" + request)
+
+
+def test_streaming_handler_error_surfaces(server):
+    """A failing stream handler must error the stream, not complete OK."""
+    srv, _ = server
+    # register on a fresh server to keep the shared fixture clean
+    executor = srv.executor
+    fresh = Server("127.0.0.1:0", type(executor)())
+    svc = AsyncService(ECHO)
+    svc.register_rpc("FailStream", FailingStreamContext)
+    fresh.register_async_service(svc)
+    fresh.async_start()
+    fresh.wait_until_running()
+    try:
+        responses = []
+        with ClientExecutor(f"127.0.0.1:{fresh.bound_port}") as cx:
+            stream = ClientStreaming(cx, f"/{ECHO}/FailStream",
+                                     responses.append)
+            stream.write(b"fine")
+            stream.write(b"boom")
+            stream.writes_done()
+            with pytest.raises(Exception):
+                stream.done().result(timeout=10)
+    finally:
+        fresh.shutdown()
+
+
+def test_invalid_remote_input_does_not_exhaust_buffers():
+    """DoS regression: bad requests must not leak buffer-pool slots."""
+    import numpy as np
+    import tpulab
+    from tpulab.models.mnist import make_mnist
+    from tpulab.rpc.infer_service import RemoteInferenceManager
+
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1, max_buffers=2)
+    mgr.register_model("mnist", make_mnist(max_batch_size=2))
+    mgr.update_resources()
+    mgr.serve(port=0)
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        runner = remote.infer_runner("mnist")
+        bad = np.zeros((1, 28, 28, 1), np.float64)  # wrong dtype
+        for _ in range(6):  # 3x the pool size
+            with pytest.raises(RuntimeError, match="INVALID_ARGUMENT"):
+                runner.infer(Input3=bad).result(timeout=30)
+        # pool must still be healthy
+        good = np.zeros((1, 28, 28, 1), np.float32)
+        out = runner.infer(Input3=good).result(timeout=30)
+        assert out["Plus214_Output_0"].shape == (1, 10)
+    finally:
+        remote.close()
+        mgr.shutdown()
+
+
+def test_local_bad_input_does_not_leak_buffers():
+    """Same leak via the local API (InferRunner.infer error path)."""
+    import numpy as np
+    from tpulab.engine import InferenceManager
+    from tpulab.models.mnist import make_mnist
+
+    mgr = InferenceManager(max_executions=1, max_buffers=1)
+    mgr.register_model("m", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    try:
+        runner = mgr.infer_runner("m")
+        for _ in range(3):
+            with pytest.raises(TypeError):
+                runner.infer(Input3=np.zeros((1, 28, 28, 1), np.float64))
+        out = runner.infer(
+            Input3=np.zeros((1, 28, 28, 1), np.float32)).result(timeout=30)
+        assert out["Plus214_Output_0"].shape == (1, 10)
+    finally:
+        mgr.shutdown()

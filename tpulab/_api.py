@@ -1,0 +1,60 @@
+"""Top-level serving API — the reference pybind module surface
+(reference trtlab/pybind/trtlab/infer.cc:683-735: InferenceManager,
+InferRunner, RemoteInferenceManager, InferFuture).
+
+The engine's InferenceManager already speaks numpy, so this layer only adds
+the module-level ergonomics: ``serve()`` (reference manager.serve()) and the
+remote manager re-export.  ``runner.infer(**arrays)`` returns a
+concurrent.futures.Future — ``.result()`` plays InferFuture.get() (the GIL is
+released inside grpc/jax waits, matching the reference's gil_scoped_release
+discipline; pure-Python code holds it by construction).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpulab.engine.inference_manager import InferenceManager as _EngineManager
+from tpulab.rpc.infer_service import (InferRemoteRunner,  # noqa: F401
+                                      RemoteInferenceManager,
+                                      build_infer_service)
+
+
+class InferenceManager(_EngineManager):
+    """Engine manager + serve() (reference PyInferenceManager)."""
+
+    def __init__(self, max_exec_concurrency: int = 2, max_buffers: int = 0,
+                 device=None):
+        # reference kwarg name: max_exec_concurrency (infer.cc:86-96)
+        super().__init__(max_executions=max_exec_concurrency,
+                         max_buffers=max_buffers, device=device)
+        self._server = None
+
+    def serve(self, port: int = 50051, wait: bool = False,
+              executor=None) -> "InferenceManager":
+        """Expose registered models over the TRTIS-style gRPC service
+        (reference manager.serve() -> BasicInferService)."""
+        if not self._allocated:
+            self.update_resources()
+        self._server = build_infer_service(
+            self, f"0.0.0.0:{port}", executor=executor)
+        if wait:
+            self._server.run()
+        else:
+            self._server.async_start()
+            self._server.wait_until_running()
+        return self
+
+    @property
+    def server(self):
+        return self._server
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+        super().shutdown()
+
+
+def serve(manager: InferenceManager, port: int = 50051, **kw):
+    return manager.serve(port=port, **kw)

@@ -92,6 +92,10 @@ class Bindings:
         if not self.model.is_input(name):
             raise KeyError(f"{name} is not an input binding")
         view = self.host_inputs[name]
+        if array.dtype != spec.np_dtype:
+            raise TypeError(f"input {name} dtype {array.dtype} != binding "
+                            f"dtype {spec.np_dtype} (no implicit casts on "
+                            f"the serving path)")
         n = array.shape[0]
         if n != self.batch_size:
             raise ValueError(f"input {name} batch {n} != bindings batch "

@@ -41,9 +41,13 @@ class InferRunner:
             raise ValueError("no input arrays given")
         batch = next(iter(arrays.values())).shape[0]
         buffers_item = self._mgr.get_buffers()           # MAY BLOCK (backpressure)
-        bindings = buffers_item.get().create_bindings(self.model, batch)
-        for name, arr in arrays.items():
-            bindings.set_input(name, np.ascontiguousarray(arr))
+        try:
+            bindings = buffers_item.get().create_bindings(self.model, batch)
+            for name, arr in arrays.items():
+                bindings.set_input(name, np.ascontiguousarray(arr))
+        except BaseException:
+            buffers_item.release()                       # never leak the slot
+            raise
         return self.infer_bindings(bindings, buffers_item, post_fn)
 
     def infer_bindings(self, bindings: Bindings, buffers_item,

@@ -1,0 +1,35 @@
+"""tpulab.rpc — async gRPC microservice framework (reference trtlab/nvrpc,
+SURVEY §2.4, ~5.9k LoC).
+
+The reference wraps gRPC's completion-queue API in context state machines so
+services are written as small classes; this build keeps that surface on
+grpc-python:
+
+- :class:`Server` — owns the grpc server, registered services and executors
+  (reference server.h:40-89)
+- :class:`AsyncService`/:func:`register_rpc` — method table binding RPC names
+  to Context classes (reference service.h:35-61, rpc.h:35-73)
+- :class:`Context` / :class:`StreamingContext` / :class:`BatchingContext` —
+  per-request lifecycles (reference context.h:41-158, life_cycle_unary.h,
+  life_cycle_streaming.h, life_cycle_batching.h)
+- :class:`Executor` / :class:`FiberExecutor` — thread-pool vs event-loop
+  execution domains (reference executor.h:39-113, fiber/executor.h:37-64).
+  With FiberExecutor, context bodies are coroutines and may await pool
+  readiness without stalling any OS thread — the fiber property.
+- client: :class:`ClientExecutor`, :class:`ClientUnary`, streaming client
+  (reference client/*.h)
+- :mod:`infer_service` — the TRTIS-protocol inference service + remote
+  client (reference pybind BasicInferService / PyRemoteInferenceManager)
+"""
+
+from tpulab.rpc.context import Context, StreamingContext, BatchingContext
+from tpulab.rpc.executor import Executor, FiberExecutor
+from tpulab.rpc.server import Server, AsyncService
+from tpulab.rpc.client import ClientExecutor, ClientUnary, ClientStreaming
+
+__all__ = [
+    "Context", "StreamingContext", "BatchingContext",
+    "Executor", "FiberExecutor",
+    "Server", "AsyncService",
+    "ClientExecutor", "ClientUnary", "ClientStreaming",
+]

@@ -1,0 +1,139 @@
+"""RPC clients (reference client/executor.h, client_unary.h:41-140,
+client_streaming*.h).
+
+- ``ClientExecutor``: channel pool with round-robin handout (reference
+  client Executor GetNextCQ)
+- ``ClientUnary``: async unary client — ``start(request)`` returns a future
+  whose completion runs the wrapped on_complete callback (reference
+  PrepareFn/StartCall + async_compute)
+- ``ClientStreaming``: bidirectional stream with a background writer queue,
+  read callback, and ``done()`` future (reference client_streaming v3 +
+  client_single_up_multiple_down)
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+import grpc
+
+from tpulab.core.async_compute import SharedPackagedTask
+
+_WRITES_DONE = object()
+
+
+class ClientExecutor:
+    """Round-robin channel pool (reference client Executor)."""
+
+    def __init__(self, target: str, channels: int = 1,
+                 options: Optional[list] = None):
+        self.target = target
+        self._channels: List[grpc.Channel] = [
+            grpc.insecure_channel(target, options=options)
+            for _ in range(max(1, channels))]
+        self._rr = itertools.cycle(range(len(self._channels)))
+
+    def channel(self) -> grpc.Channel:
+        return self._channels[next(self._rr)]
+
+    def close(self) -> None:
+        for ch in self._channels:
+            ch.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class ClientUnary:
+    """Future-returning unary client (reference ClientUnary)."""
+
+    def __init__(self, executor: ClientExecutor, method: str,
+                 request_serializer: Callable[[Any], bytes] = None,
+                 response_deserializer: Callable[[bytes], Any] = None):
+        self._executor = executor
+        self._method = method
+        self._ser = request_serializer
+        self._des = response_deserializer
+
+    def _stub(self):
+        return self._executor.channel().unary_unary(
+            self._method, request_serializer=self._ser,
+            response_deserializer=self._des)
+
+    def start(self, request, on_complete: Optional[Callable] = None,
+              timeout: Optional[float] = None) -> Future:
+        """Async call; returns a future of on_complete(response) (identity
+        by default).  Mirrors async_compute-wrapped completions."""
+        task = SharedPackagedTask(on_complete or (lambda resp: resp))
+        call = self._stub().future(request, timeout=timeout)
+
+        def _done(c):
+            try:
+                task(c.result())
+            except BaseException as e:  # noqa: BLE001
+                fut = task.get_future()
+                if not fut.done():
+                    fut.set_exception(e)
+        call.add_done_callback(_done)
+        return task.get_future()
+
+    def call(self, request, timeout: Optional[float] = None):
+        """Blocking convenience."""
+        return self.start(request, timeout=timeout).result(timeout)
+
+
+class ClientStreaming:
+    """Bidirectional streaming client (reference client_streaming v3)."""
+
+    def __init__(self, executor: ClientExecutor, method: str,
+                 on_response: Callable[[Any], None],
+                 request_serializer: Callable[[Any], bytes] = None,
+                 response_deserializer: Callable[[bytes], Any] = None):
+        self._on_response = on_response
+        self._writes: "_queue.Queue" = _queue.Queue()
+        self._done: Future = Future()
+        stub = executor.channel().stream_stream(
+            method, request_serializer=request_serializer,
+            response_deserializer=response_deserializer)
+
+        def request_iter():
+            while True:
+                item = self._writes.get()
+                if item is _WRITES_DONE:
+                    return
+                yield item
+
+        self._call = stub(request_iter())
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            for resp in self._call:
+                self._on_response(resp)
+            self._done.set_result(None)
+        except BaseException as e:  # noqa: BLE001
+            if not self._done.done():
+                self._done.set_exception(e)
+
+    def write(self, request) -> None:
+        """Queue a request (reference Write; thread-safe)."""
+        self._writes.put(request)
+
+    def writes_done(self) -> None:
+        """Half-close (reference WritesDone)."""
+        self._writes.put(_WRITES_DONE)
+
+    def done(self) -> Future:
+        """Future resolving when the server finishes the stream."""
+        return self._done
+
+    def cancel(self) -> None:
+        self._call.cancel()

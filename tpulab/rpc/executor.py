@@ -1,0 +1,42 @@
+"""Execution domains for RPC handlers (reference executor.h:39-113,
+fiber/executor.h:37-64).
+
+- ``Executor(n_threads, contexts_per_thread)``: handlers run on a thread
+  pool; ``max_concurrency = n_threads * contexts_per_thread`` bounds in-flight
+  requests (the reference pre-arms cq contexts_per_thread contexts per CQ
+  thread; grpc-python expresses the same bound via maximum_concurrent_rpcs).
+- ``FiberExecutor``: handlers are coroutines on a grpc.aio event loop; a
+  blocked handler (awaiting a pool pop or device readiness) costs no OS
+  thread — the reference's detached-fiber-per-event property.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class Executor:
+    """Thread-pool execution domain (reference Executor)."""
+
+    n_threads: int = 2
+    contexts_per_thread: int = 100
+
+    @property
+    def max_concurrency(self) -> int:
+        return self.n_threads * self.contexts_per_thread
+
+    is_fiber = False
+
+
+@dataclass
+class FiberExecutor:
+    """Event-loop execution domain (reference FiberExecutor)."""
+
+    contexts: int = 1000  # max in-flight requests
+
+    @property
+    def max_concurrency(self) -> int:
+        return self.contexts
+
+    is_fiber = True

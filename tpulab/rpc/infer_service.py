@@ -1,0 +1,217 @@
+"""TRTIS-protocol inference service + remote client
+(reference pybind BasicInferService infer.cc:547-678 and
+PyRemoteInferenceManager/PyInferRemoteRunner infer.cc:124-404;
+protocol shape from examples/11_Protos nvidia_inference.proto).
+
+Serving path per request (reference InferContext infer.cc:596-642):
+proto tensors -> staging bindings -> InferRunner pipeline -> raw-output
+response, with the response built on the post stage.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from tpulab.core.resources import Resources
+from tpulab.rpc.client import ClientExecutor, ClientUnary
+from tpulab.rpc.context import Context
+from tpulab.rpc.executor import Executor
+from tpulab.rpc.protos import inference_pb2 as pb
+from tpulab.rpc.server import AsyncService, Server
+
+log = logging.getLogger("tpulab.rpc")
+
+SERVICE_NAME = "tpulab.inference.GRPCService"
+SERVER_VERSION = "tpulab-0.1"
+
+
+# -- tensor <-> proto ---------------------------------------------------------
+def tensor_to_proto(name: str, array: np.ndarray) -> pb.TensorProto:
+    array = np.ascontiguousarray(array)
+    return pb.TensorProto(name=name, dtype=array.dtype.name,
+                          dims=list(array.shape), raw_data=array.tobytes())
+
+
+def proto_to_tensor(t: pb.TensorProto) -> np.ndarray:
+    return np.frombuffer(t.raw_data, dtype=np.dtype(t.dtype)).reshape(
+        tuple(t.dims))
+
+
+class InferResources(Resources):
+    """Service resources: the InferenceManager (reference TestResources
+    pattern — Resources bundle handed to contexts)."""
+
+    def __init__(self, manager):
+        self.manager = manager
+
+
+class StatusContext(Context):
+    """Model-listing RPC (reference StatusContext infer.cc:547-594)."""
+
+    def execute_rpc(self, request: pb.StatusRequest) -> pb.StatusResponse:
+        mgr = self.get_resources(InferResources).manager
+        resp = pb.StatusResponse(server_version=SERVER_VERSION)
+        names = ([request.model_name] if request.model_name
+                 else mgr.model_names)
+        for name in names:
+            if name not in mgr.model_names:
+                resp.status.code = pb.UNKNOWN_MODEL
+                resp.status.message = f"unknown model {name!r}"
+                return resp
+            m = mgr.model(name)
+            ms = pb.ModelStatus(name=name, max_batch_size=m.max_batch_size,
+                                batch_buckets=list(m.batch_buckets),
+                                weights_bytes=m.weights_size_in_bytes())
+            for s in m.inputs:
+                ms.inputs.append(pb.ModelIOSpec(
+                    name=s.name, dtype=s.np_dtype.name, dims=list(s.shape)))
+            for s in m.outputs:
+                ms.outputs.append(pb.ModelIOSpec(
+                    name=s.name, dtype=s.np_dtype.name, dims=list(s.shape)))
+            resp.models.append(ms)
+        resp.status.code = pb.SUCCESS
+        return resp
+
+
+class InferContext(Context):
+    """Unary inference RPC (reference InferContext infer.cc:596-642)."""
+
+    def execute_rpc(self, request: pb.InferRequest) -> pb.InferResponse:
+        mgr = self.get_resources(InferResources).manager
+        resp = pb.InferResponse(model_name=request.model_name,
+                                correlation_id=request.correlation_id)
+        if request.model_name not in mgr.model_names:
+            resp.status.code = pb.UNKNOWN_MODEL
+            resp.status.message = f"unknown model {request.model_name!r}"
+            return resp
+        model = mgr.model(request.model_name)
+        try:
+            arrays = {t.name: proto_to_tensor(t) for t in request.inputs}
+            # validate against the model spec BEFORE touching pooled
+            # resources: bad remote input must not consume a buffer slot
+            input_names = {s.name for s in model.inputs}
+            if set(arrays) != input_names:
+                raise ValueError(f"inputs {sorted(arrays)} != model bindings "
+                                 f"{sorted(input_names)}")
+            for s in model.inputs:
+                arr = arrays[s.name]
+                if arr.dtype != s.np_dtype:
+                    raise TypeError(f"input {s.name} dtype {arr.dtype} != "
+                                    f"{s.np_dtype}")
+                if tuple(arr.shape[1:]) != s.shape:
+                    raise ValueError(f"input {s.name} shape {arr.shape[1:]} "
+                                     f"!= {s.shape}")
+                if arr.shape[0] > model.max_batch_size:
+                    raise ValueError(f"batch {arr.shape[0]} exceeds "
+                                     f"max_batch_size {model.max_batch_size}")
+        except Exception as e:
+            resp.status.code = pb.INVALID_ARGUMENT
+            resp.status.message = str(e)
+            return resp
+        try:
+            runner = mgr.infer_runner(request.model_name)
+            outputs = runner.infer(**arrays).result()
+            wanted = set(request.requested_outputs) or set(outputs)
+            for name, arr in outputs.items():
+                if name in wanted:
+                    resp.outputs.append(tensor_to_proto(name, arr))
+            resp.status.code = pb.SUCCESS
+        except Exception as e:  # noqa: BLE001
+            log.exception("inference failed")
+            resp.status.code = pb.INTERNAL
+            resp.status.message = str(e)
+        return resp
+
+
+class HealthContext(Context):
+    def execute_rpc(self, request: pb.HealthRequest) -> pb.HealthResponse:
+        res = self.get_resources(InferResources)
+        return pb.HealthResponse(live=True, ready=res.manager is not None)
+
+
+def build_infer_service(manager, address: str = "0.0.0.0:0",
+                        executor: Optional[Executor] = None) -> Server:
+    """Wire the inference service onto a Server
+    (reference BasicInferService ctor infer.cc:644-678)."""
+    resources = InferResources(manager)
+    server = Server(address, executor or Executor(n_threads=4))
+    service = AsyncService(SERVICE_NAME, resources)
+    service.register_rpc("Status", StatusContext,
+                         pb.StatusRequest.FromString,
+                         pb.StatusResponse.SerializeToString)
+    service.register_rpc("Infer", InferContext,
+                         pb.InferRequest.FromString,
+                         pb.InferResponse.SerializeToString)
+    service.register_rpc("Health", HealthContext,
+                         pb.HealthRequest.FromString,
+                         pb.HealthResponse.SerializeToString)
+    server.register_async_service(service)
+    return server
+
+
+# -- remote client ------------------------------------------------------------
+class RemoteInferenceManager:
+    """Client-side manager (reference PyRemoteInferenceManager)."""
+
+    def __init__(self, hostname: str = "localhost:50051", channels: int = 1):
+        self._executor = ClientExecutor(hostname, channels)
+        self._status = ClientUnary(
+            self._executor, f"/{SERVICE_NAME}/Status",
+            pb.StatusRequest.SerializeToString, pb.StatusResponse.FromString)
+        self._infer = ClientUnary(
+            self._executor, f"/{SERVICE_NAME}/Infer",
+            pb.InferRequest.SerializeToString, pb.InferResponse.FromString)
+
+    def get_models(self) -> Dict[str, pb.ModelStatus]:
+        resp = self._status.call(pb.StatusRequest())
+        if resp.status.code != pb.SUCCESS:
+            raise RuntimeError(f"Status failed: {resp.status.message}")
+        return {m.name: m for m in resp.models}
+
+    def infer_runner(self, model_name: str) -> "InferRemoteRunner":
+        models = self.get_models()
+        if model_name not in models:
+            raise KeyError(f"unknown remote model {model_name!r}")
+        return InferRemoteRunner(self, model_name, models[model_name])
+
+    def close(self) -> None:
+        self._executor.close()
+
+
+class InferRemoteRunner:
+    """numpy-in / numpy-out remote runner (reference PyInferRemoteRunner)."""
+
+    def __init__(self, manager: RemoteInferenceManager, model_name: str,
+                 status: pb.ModelStatus):
+        self._mgr = manager
+        self.model_name = model_name
+        self.status = status
+
+    def input_bindings(self) -> Dict[str, tuple]:
+        return {s.name: (tuple(s.dims), np.dtype(s.dtype))
+                for s in self.status.inputs}
+
+    def output_bindings(self) -> Dict[str, tuple]:
+        return {s.name: (tuple(s.dims), np.dtype(s.dtype))
+                for s in self.status.outputs}
+
+    def infer(self, **arrays: np.ndarray):
+        """Future of dict-of-numpy outputs."""
+        if not arrays:
+            raise ValueError("no input arrays")
+        batch = next(iter(arrays.values())).shape[0]
+        req = pb.InferRequest(model_name=self.model_name, batch_size=batch)
+        for name, arr in arrays.items():
+            req.inputs.append(tensor_to_proto(name, arr))
+
+        def on_complete(resp: pb.InferResponse) -> Dict[str, np.ndarray]:
+            if resp.status.code != pb.SUCCESS:
+                raise RuntimeError(
+                    f"remote inference failed ({pb.StatusCode.Name(resp.status.code)}): "
+                    f"{resp.status.message}")
+            return {t.name: proto_to_tensor(t) for t in resp.outputs}
+
+        return self._mgr._infer.start(req, on_complete)
