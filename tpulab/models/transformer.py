@@ -44,14 +44,20 @@ def _rmsnorm(x, scale):
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
 
 
-def causal_attention(q, k, v):
-    """Default single-device causal attention (B, T, H, D)."""
+def dense_attention(q, k, v, causal: bool = True):
+    """Single-device attention (B, T, H, D), optionally causal."""
     b, t, h, d = q.shape
     scores = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(d)
-    mask = jnp.tril(jnp.ones((t, t), bool))
-    scores = jnp.where(mask[None, None], scores, -1e30)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def causal_attention(q, k, v):
+    """Default single-device causal attention (B, T, H, D)."""
+    return dense_attention(q, k, v, causal=True)
 
 
 def transformer_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
