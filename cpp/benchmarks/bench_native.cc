@@ -1,0 +1,73 @@
+// Native microbenchmarks (reference bench_memory_stack.cc / bench_pool.cc
+// style: transactional vs malloc, pool pop cost, mutex handoff).
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+
+#include "tpulab/arena.h"
+#include "tpulab/hybrid_mutex.h"
+#include "tpulab/pool.h"
+#include "tpulab/transactional.h"
+
+using namespace tpulab;
+using clk = std::chrono::steady_clock;
+
+static double ns_per_op(clk::time_point t0, clk::time_point t1, long n) {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(t1 - t0).count() /
+         double(n);
+}
+
+int main() {
+  constexpr long N = 1'000'000;
+
+  {
+    BlockArena arena(1 << 20);
+    TransactionalAllocator tx(&arena);
+    auto t0 = clk::now();
+    for (long i = 0; i < N; ++i) {
+      void* p = tx.allocate(256);
+      tx.deallocate(p);
+    }
+    auto t1 = clk::now();
+    std::printf("transactional alloc/free 256B: %.1f ns/op\n",
+                ns_per_op(t0, t1, N));
+  }
+  {
+    auto t0 = clk::now();
+    for (long i = 0; i < N; ++i) {
+      void* p = std::malloc(256);
+      __asm__ __volatile__("" ::"r"(p) : "memory");  // defeat elision
+      std::free(p);
+    }
+    auto t1 = clk::now();
+    std::printf("malloc/free 256B:              %.1f ns/op\n",
+                ns_per_op(t0, t1, N));
+  }
+  {
+    TokenPool pool;
+    pool.push(1);
+    int64_t tok;
+    auto t0 = clk::now();
+    for (long i = 0; i < N; ++i) {
+      pool.pop(&tok);
+      pool.push(tok);
+    }
+    auto t1 = clk::now();
+    std::printf("token pool pop/push:           %.1f ns/op\n",
+                ns_per_op(t0, t1, N));
+  }
+  {
+    HybridMutex mu;
+    auto t0 = clk::now();
+    for (long i = 0; i < N; ++i) {
+      mu.lock();
+      mu.unlock();
+    }
+    auto t1 = clk::now();
+    std::printf("hybrid mutex lock/unlock:      %.1f ns/op\n",
+                ns_per_op(t0, t1, N));
+  }
+  return 0;
+}

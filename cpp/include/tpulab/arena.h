@@ -1,0 +1,37 @@
+// Caching block arena over aligned host memory.
+// Native analog of the reference's block_arena.h:47-170 (cached policy):
+// fixed-size blocks from aligned_alloc, freed blocks recycled on a free list.
+#pragma once
+
+#include <cstddef>
+#include <mutex>
+#include <vector>
+
+namespace tpulab {
+
+class BlockArena {
+ public:
+  BlockArena(size_t block_size, size_t alignment = 64, size_t max_blocks = 0);
+  ~BlockArena();
+  BlockArena(const BlockArena&) = delete;
+  BlockArena& operator=(const BlockArena&) = delete;
+
+  // nullptr when max_blocks is reached
+  void* allocate_block();
+  void deallocate_block(void* block);
+
+  size_t block_size() const { return block_size_; }
+  size_t live_blocks() const;
+  size_t cached_blocks() const;
+  size_t shrink_to_fit();  // returns bytes released
+
+ private:
+  size_t block_size_;
+  size_t alignment_;
+  size_t max_blocks_;
+  mutable std::mutex mu_;
+  std::vector<void*> cache_;
+  size_t live_ = 0;
+};
+
+}  // namespace tpulab

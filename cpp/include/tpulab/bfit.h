@@ -1,0 +1,44 @@
+// Best-fit allocator with size-ordered and address-ordered free views.
+// Native analog of the reference's bfit_allocator.h:20-123: long-lived
+// variable-size allocations (weights/artifacts); frees coalesce with
+// address neighbors.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <set>
+#include <vector>
+
+#include "tpulab/arena.h"
+
+namespace tpulab {
+
+class BFitAllocator {
+ public:
+  explicit BFitAllocator(BlockArena* arena, bool grow_on_demand = true);
+  ~BFitAllocator();
+
+  void* allocate(size_t size, size_t alignment = 64);
+  bool deallocate(void* ptr);
+
+  size_t free_bytes() const;
+  size_t live_allocations() const;
+
+ private:
+  void insert_free_locked(uintptr_t addr, size_t size);
+  void remove_free_locked(uintptr_t addr);
+
+  BlockArena* arena_;
+  bool grow_;
+  mutable std::mutex mu_;
+  std::vector<void*> blocks_;
+  // addr -> span size (address-ordered, for coalescing)
+  std::map<uintptr_t, size_t> free_by_addr_;
+  // (size, addr) ordered set (for best-fit search)
+  std::set<std::pair<size_t, uintptr_t>> free_by_size_;
+  std::map<uintptr_t, size_t> live_;
+};
+
+}  // namespace tpulab

@@ -1,0 +1,66 @@
+// C API for the tpulab native runtime core, consumed from Python via cffi
+// (tpulab/native/__init__.py).  Opaque handles, no exceptions across the
+// boundary; 0/NULL signals failure.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---- block arena ----
+typedef struct tpl_arena tpl_arena;
+tpl_arena* tpl_arena_create(size_t block_size, size_t alignment,
+                            size_t max_blocks);
+void tpl_arena_destroy(tpl_arena*);
+void* tpl_arena_allocate_block(tpl_arena*);
+void tpl_arena_deallocate_block(tpl_arena*, void* block);
+size_t tpl_arena_block_size(tpl_arena*);
+size_t tpl_arena_live_blocks(tpl_arena*);
+size_t tpl_arena_cached_blocks(tpl_arena*);
+size_t tpl_arena_shrink(tpl_arena*);
+
+// ---- transactional allocator ----
+typedef struct tpl_txalloc tpl_txalloc;
+tpl_txalloc* tpl_txalloc_create(tpl_arena*, size_t max_stacks);
+void tpl_txalloc_destroy(tpl_txalloc*);
+void* tpl_txalloc_allocate(tpl_txalloc*, size_t size, size_t alignment);
+int tpl_txalloc_deallocate(tpl_txalloc*, void* ptr);
+size_t tpl_txalloc_live_stacks(tpl_txalloc*);
+
+// ---- best-fit allocator ----
+typedef struct tpl_bfit tpl_bfit;
+tpl_bfit* tpl_bfit_create(tpl_arena*, int grow_on_demand);
+void tpl_bfit_destroy(tpl_bfit*);
+void* tpl_bfit_allocate(tpl_bfit*, size_t size, size_t alignment);
+int tpl_bfit_deallocate(tpl_bfit*, void* ptr);
+size_t tpl_bfit_free_bytes(tpl_bfit*);
+size_t tpl_bfit_live(tpl_bfit*);
+
+// ---- token pool ----
+typedef struct tpl_pool tpl_pool;
+tpl_pool* tpl_pool_create(void);
+void tpl_pool_destroy(tpl_pool*);
+void tpl_pool_push(tpl_pool*, int64_t token);
+// timeout_ns < 0 blocks forever; returns 0 on timeout, 1 on success
+int tpl_pool_pop(tpl_pool*, int64_t* token, int64_t timeout_ns);
+int tpl_pool_try_pop(tpl_pool*, int64_t* token);
+size_t tpl_pool_size(tpl_pool*);
+
+// ---- thread pool ----
+typedef struct tpl_threadpool tpl_threadpool;
+typedef void (*tpl_task_fn)(void* user);
+tpl_threadpool* tpl_threadpool_create(size_t n_threads, const int* cpus,
+                                      size_t n_cpus);
+void tpl_threadpool_destroy(tpl_threadpool*);
+void tpl_threadpool_enqueue(tpl_threadpool*, tpl_task_fn fn, void* user);
+void tpl_threadpool_drain(tpl_threadpool*);
+size_t tpl_threadpool_size(tpl_threadpool*);
+
+const char* tpl_version(void);
+
+#ifdef __cplusplus
+}
+#endif

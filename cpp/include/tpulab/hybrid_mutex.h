@@ -1,0 +1,42 @@
+// Futex-based spin-then-sleep mutex + condition variable.
+// Native analog of the reference's hybrid_mutex.h:27-186 /
+// hybrid_condition.h:27-214 (x86 pause loop, FUTEX_WAIT_PRIVATE): a short
+// adaptive spin captures sub-microsecond handoffs (pool pop/push between
+// pre/dispatch/post stages); the futex sleep path keeps idle cost at zero.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace tpulab {
+
+class HybridMutex {
+ public:
+  HybridMutex() = default;
+  HybridMutex(const HybridMutex&) = delete;
+  HybridMutex& operator=(const HybridMutex&) = delete;
+
+  void lock();
+  bool try_lock();
+  void unlock();
+
+ private:
+  friend class HybridCondition;
+  // 0 = unlocked, 1 = locked uncontended, 2 = locked contended
+  std::atomic<uint32_t> state_{0};
+  static constexpr int kSpins = 100;
+};
+
+class HybridCondition {
+ public:
+  void wait(HybridMutex& m);
+  // timeout in nanoseconds; returns false on timeout
+  bool wait_for(HybridMutex& m, int64_t timeout_ns);
+  void notify_one();
+  void notify_all();
+
+ private:
+  std::atomic<uint32_t> seq_{0};
+};
+
+}  // namespace tpulab

@@ -1,0 +1,31 @@
+// MPMC blocking resource pool over the hybrid futex primitives.
+// Native analog of the reference's v4::Pool (pool.h:454-638): integer tokens
+// (resource ids) pushed/popped with blocking semantics — the backpressure
+// primitive under the InferenceManager's execution slots.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <deque>
+
+#include "tpulab/hybrid_mutex.h"
+
+namespace tpulab {
+
+class TokenPool {
+ public:
+  explicit TokenPool(size_t capacity_hint = 0);
+
+  void push(int64_t token);
+  // blocks up to timeout_ns (-1 = forever); returns false on timeout
+  bool pop(int64_t* token, int64_t timeout_ns = -1);
+  bool try_pop(int64_t* token);
+  size_t size() const;
+
+ private:
+  mutable HybridMutex mu_;
+  HybridCondition cv_;
+  std::deque<int64_t> items_;
+};
+
+}  // namespace tpulab

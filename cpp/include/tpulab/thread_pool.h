@@ -1,0 +1,39 @@
+// Work-queue thread pool with CPU pinning.
+// Native analog of the reference's thread_pool.h:73-298 (affinity ctors
+// 94-116): N workers optionally pinned to explicit CPUs.
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace tpulab {
+
+class ThreadPool {
+ public:
+  // cpus: one entry per worker (-1 = unpinned); empty -> n unpinned workers
+  ThreadPool(size_t n_threads, const std::vector<int>& cpus = {});
+  ~ThreadPool();
+
+  void enqueue(std::function<void()> fn);
+  size_t size() const { return workers_.size(); }
+  // waits until all queued work at call time is done
+  void drain();
+
+ private:
+  void worker(int cpu);
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable drain_cv_;
+  std::queue<std::function<void()>> tasks_;
+  std::vector<std::thread> workers_;
+  size_t active_ = 0;
+  bool shutdown_ = false;
+};
+
+}  // namespace tpulab

@@ -1,0 +1,56 @@
+// Transactional allocator: rotating ref-counted bump stacks.
+// Native analog of the reference's transactional_allocator.h:155-367:
+// O(1) bump allocation from the current stack, rotation when it cannot fit a
+// request, whole-stack release back to the arena when the last allocation
+// drops.  Backs per-request staging scratch on the serving hot path.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+#include "tpulab/arena.h"
+
+namespace tpulab {
+
+class TransactionalAllocator {
+ public:
+  TransactionalAllocator(BlockArena* arena, size_t max_stacks = 0);
+  ~TransactionalAllocator();
+
+  // nullptr on exhaustion / oversize
+  void* allocate(size_t size, size_t alignment = 64);
+  // Pointer MUST come from allocate() (free()-style contract; the in-band
+  // header is validated against live stacks, but reading the header of an
+  // arbitrary address is undefined).  Returns false if validation fails.
+  bool deallocate(void* ptr);
+
+  //: 8-byte in-band header before every allocation (see allocate())
+  static constexpr size_t kHeader = sizeof(void*);
+
+  size_t live_stacks() const;
+  // largest size allocate() can satisfy at the given alignment
+  size_t max_allocation_size(size_t alignment = 64) const {
+    return arena_->block_size() - kHeader - alignment;
+  }
+
+ private:
+  struct Stack {
+    char* base;
+    size_t cursor = 0;
+    size_t refs = 0;
+    bool retired = false;
+  };
+
+  Stack* rotate_locked();
+  void release_stack_locked(Stack* s);
+
+  BlockArena* arena_;
+  size_t max_stacks_;
+  mutable std::mutex mu_;
+  Stack* current_ = nullptr;
+  std::vector<Stack*> stacks_;
+};
+
+}  // namespace tpulab

@@ -1,0 +1,60 @@
+#include "tpulab/arena.h"
+
+#include <cstdlib>
+
+namespace tpulab {
+
+BlockArena::BlockArena(size_t block_size, size_t alignment, size_t max_blocks)
+    : block_size_((block_size + alignment - 1) / alignment * alignment),
+      alignment_(alignment),
+      max_blocks_(max_blocks) {}
+
+BlockArena::~BlockArena() {
+  for (void* b : cache_) std::free(b);
+}
+
+void* BlockArena::allocate_block() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!cache_.empty()) {
+      void* b = cache_.back();
+      cache_.pop_back();
+      ++live_;
+      return b;
+    }
+    if (max_blocks_ && live_ >= max_blocks_) return nullptr;
+    ++live_;
+  }
+  void* b = std::aligned_alloc(alignment_, block_size_);
+  if (!b) {
+    std::lock_guard<std::mutex> lk(mu_);
+    --live_;  // roll back: the slot was never materialized
+  }
+  return b;
+}
+
+void BlockArena::deallocate_block(void* block) {
+  std::lock_guard<std::mutex> lk(mu_);
+  cache_.push_back(block);
+  --live_;
+}
+
+size_t BlockArena::live_blocks() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return live_;
+}
+
+size_t BlockArena::cached_blocks() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return cache_.size();
+}
+
+size_t BlockArena::shrink_to_fit() {
+  std::lock_guard<std::mutex> lk(mu_);
+  size_t freed = cache_.size() * block_size_;
+  for (void* b : cache_) std::free(b);
+  cache_.clear();
+  return freed;
+}
+
+}  // namespace tpulab

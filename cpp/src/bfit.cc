@@ -1,0 +1,96 @@
+#include "tpulab/bfit.h"
+
+namespace tpulab {
+
+namespace {
+inline uintptr_t align_up(uintptr_t v, size_t a) { return (v + a - 1) & ~(a - 1); }
+}  // namespace
+
+BFitAllocator::BFitAllocator(BlockArena* arena, bool grow_on_demand)
+    : arena_(arena), grow_(grow_on_demand) {}
+
+BFitAllocator::~BFitAllocator() {
+  for (void* b : blocks_) arena_->deallocate_block(b);
+}
+
+void BFitAllocator::insert_free_locked(uintptr_t addr, size_t size) {
+  // coalesce with predecessor
+  auto it = free_by_addr_.lower_bound(addr);
+  if (it != free_by_addr_.begin()) {
+    auto prev = std::prev(it);
+    if (prev->first + prev->second == addr) {
+      addr = prev->first;
+      size += prev->second;
+      free_by_size_.erase({prev->second, prev->first});
+      free_by_addr_.erase(prev);
+    }
+  }
+  // coalesce with successor
+  it = free_by_addr_.lower_bound(addr);
+  if (it != free_by_addr_.end() && addr + size == it->first) {
+    size += it->second;
+    free_by_size_.erase({it->second, it->first});
+    free_by_addr_.erase(it);
+  }
+  free_by_addr_[addr] = size;
+  free_by_size_.insert({size, addr});
+}
+
+void BFitAllocator::remove_free_locked(uintptr_t addr) {
+  auto it = free_by_addr_.find(addr);
+  free_by_size_.erase({it->second, it->first});
+  free_by_addr_.erase(it);
+}
+
+void* BFitAllocator::allocate(size_t size, size_t alignment) {
+  if (size == 0) return nullptr;
+  std::lock_guard<std::mutex> lk(mu_);
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    // best-fit: smallest span with room for aligned size
+    auto it = free_by_size_.lower_bound({size, 0});
+    while (it != free_by_size_.end()) {
+      auto [span, addr] = *it;
+      uintptr_t start = align_up(addr, alignment);
+      size_t pad = start - addr;
+      if (span >= pad + size) {
+        remove_free_locked(addr);
+        if (pad) insert_free_locked(addr, pad);
+        size_t rem = span - pad - size;
+        if (rem) insert_free_locked(start + size, rem);
+        live_[start] = size;
+        return reinterpret_cast<void*>(start);
+      }
+      ++it;
+    }
+    if (!grow_ || attempt == 1) break;
+    void* block = arena_->allocate_block();
+    if (!block) break;
+    blocks_.push_back(block);
+    insert_free_locked(reinterpret_cast<uintptr_t>(block),
+                       arena_->block_size());
+  }
+  return nullptr;
+}
+
+bool BFitAllocator::deallocate(void* ptr) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = live_.find(reinterpret_cast<uintptr_t>(ptr));
+  if (it == live_.end()) return false;
+  insert_free_locked(it->first, it->second);
+  live_.erase(it);
+  return true;
+}
+
+size_t BFitAllocator::free_bytes() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  size_t total = 0;
+  for (auto& [addr, size] : free_by_addr_) total += size;
+  return total;
+}
+
+size_t BFitAllocator::live_allocations() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return live_.size();
+}
+
+}  // namespace tpulab

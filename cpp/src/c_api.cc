@@ -1,0 +1,118 @@
+#include "tpulab/c_api.h"
+
+#include <vector>
+
+#include "tpulab/arena.h"
+#include "tpulab/bfit.h"
+#include "tpulab/pool.h"
+#include "tpulab/thread_pool.h"
+#include "tpulab/transactional.h"
+
+using namespace tpulab;
+
+extern "C" {
+
+tpl_arena* tpl_arena_create(size_t block_size, size_t alignment,
+                            size_t max_blocks) {
+  return reinterpret_cast<tpl_arena*>(
+      new BlockArena(block_size, alignment ? alignment : 64, max_blocks));
+}
+void tpl_arena_destroy(tpl_arena* a) {
+  delete reinterpret_cast<BlockArena*>(a);
+}
+void* tpl_arena_allocate_block(tpl_arena* a) {
+  return reinterpret_cast<BlockArena*>(a)->allocate_block();
+}
+void tpl_arena_deallocate_block(tpl_arena* a, void* block) {
+  reinterpret_cast<BlockArena*>(a)->deallocate_block(block);
+}
+size_t tpl_arena_block_size(tpl_arena* a) {
+  return reinterpret_cast<BlockArena*>(a)->block_size();
+}
+size_t tpl_arena_live_blocks(tpl_arena* a) {
+  return reinterpret_cast<BlockArena*>(a)->live_blocks();
+}
+size_t tpl_arena_cached_blocks(tpl_arena* a) {
+  return reinterpret_cast<BlockArena*>(a)->cached_blocks();
+}
+size_t tpl_arena_shrink(tpl_arena* a) {
+  return reinterpret_cast<BlockArena*>(a)->shrink_to_fit();
+}
+
+tpl_txalloc* tpl_txalloc_create(tpl_arena* a, size_t max_stacks) {
+  return reinterpret_cast<tpl_txalloc*>(new TransactionalAllocator(
+      reinterpret_cast<BlockArena*>(a), max_stacks));
+}
+void tpl_txalloc_destroy(tpl_txalloc* t) {
+  delete reinterpret_cast<TransactionalAllocator*>(t);
+}
+void* tpl_txalloc_allocate(tpl_txalloc* t, size_t size, size_t alignment) {
+  return reinterpret_cast<TransactionalAllocator*>(t)->allocate(
+      size, alignment ? alignment : 64);
+}
+int tpl_txalloc_deallocate(tpl_txalloc* t, void* ptr) {
+  return reinterpret_cast<TransactionalAllocator*>(t)->deallocate(ptr) ? 1 : 0;
+}
+size_t tpl_txalloc_live_stacks(tpl_txalloc* t) {
+  return reinterpret_cast<TransactionalAllocator*>(t)->live_stacks();
+}
+
+tpl_bfit* tpl_bfit_create(tpl_arena* a, int grow_on_demand) {
+  return reinterpret_cast<tpl_bfit*>(
+      new BFitAllocator(reinterpret_cast<BlockArena*>(a), grow_on_demand));
+}
+void tpl_bfit_destroy(tpl_bfit* b) {
+  delete reinterpret_cast<BFitAllocator*>(b);
+}
+void* tpl_bfit_allocate(tpl_bfit* b, size_t size, size_t alignment) {
+  return reinterpret_cast<BFitAllocator*>(b)->allocate(
+      size, alignment ? alignment : 64);
+}
+int tpl_bfit_deallocate(tpl_bfit* b, void* ptr) {
+  return reinterpret_cast<BFitAllocator*>(b)->deallocate(ptr) ? 1 : 0;
+}
+size_t tpl_bfit_free_bytes(tpl_bfit* b) {
+  return reinterpret_cast<BFitAllocator*>(b)->free_bytes();
+}
+size_t tpl_bfit_live(tpl_bfit* b) {
+  return reinterpret_cast<BFitAllocator*>(b)->live_allocations();
+}
+
+tpl_pool* tpl_pool_create(void) {
+  return reinterpret_cast<tpl_pool*>(new TokenPool());
+}
+void tpl_pool_destroy(tpl_pool* p) { delete reinterpret_cast<TokenPool*>(p); }
+void tpl_pool_push(tpl_pool* p, int64_t token) {
+  reinterpret_cast<TokenPool*>(p)->push(token);
+}
+int tpl_pool_pop(tpl_pool* p, int64_t* token, int64_t timeout_ns) {
+  return reinterpret_cast<TokenPool*>(p)->pop(token, timeout_ns) ? 1 : 0;
+}
+int tpl_pool_try_pop(tpl_pool* p, int64_t* token) {
+  return reinterpret_cast<TokenPool*>(p)->try_pop(token) ? 1 : 0;
+}
+size_t tpl_pool_size(tpl_pool* p) {
+  return reinterpret_cast<TokenPool*>(p)->size();
+}
+
+tpl_threadpool* tpl_threadpool_create(size_t n_threads, const int* cpus,
+                                      size_t n_cpus) {
+  std::vector<int> pins(cpus, cpus + n_cpus);
+  return reinterpret_cast<tpl_threadpool*>(new ThreadPool(n_threads, pins));
+}
+void tpl_threadpool_destroy(tpl_threadpool* t) {
+  delete reinterpret_cast<ThreadPool*>(t);
+}
+void tpl_threadpool_enqueue(tpl_threadpool* t, tpl_task_fn fn, void* user) {
+  reinterpret_cast<ThreadPool*>(t)->enqueue([fn, user] { fn(user); });
+}
+void tpl_threadpool_drain(tpl_threadpool* t) {
+  reinterpret_cast<ThreadPool*>(t)->drain();
+}
+size_t tpl_threadpool_size(tpl_threadpool* t) {
+  return reinterpret_cast<ThreadPool*>(t)->size();
+}
+
+const char* tpl_version(void) { return "tpulab-native-0.1.0"; }
+
+}  // extern "C"

@@ -1,0 +1,81 @@
+#include "tpulab/hybrid_mutex.h"
+
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <ctime>
+
+namespace tpulab {
+namespace {
+
+#if defined(__x86_64__)
+inline void cpu_relax() { __builtin_ia32_pause(); }
+#else
+inline void cpu_relax() { __asm__ __volatile__("yield" ::: "memory"); }
+#endif
+
+long sys_futex(void* addr, int op, uint32_t val, const struct timespec* ts) {
+  return syscall(SYS_futex, addr, op, val, ts, nullptr, 0);
+}
+
+}  // namespace
+
+void HybridMutex::lock() {
+  uint32_t c = 0;
+  // fast path: uncontended acquire
+  if (state_.compare_exchange_strong(c, 1, std::memory_order_acquire)) return;
+  // adaptive spin before sleeping (reference spin-then-futex)
+  for (int i = 0; i < kSpins; ++i) {
+    cpu_relax();
+    c = 0;
+    if (state_.compare_exchange_weak(c, 1, std::memory_order_acquire)) return;
+  }
+  // slow path: mark contended and futex-wait
+  c = state_.exchange(2, std::memory_order_acquire);
+  while (c != 0) {
+    sys_futex(&state_, FUTEX_WAIT_PRIVATE, 2, nullptr);
+    c = state_.exchange(2, std::memory_order_acquire);
+  }
+}
+
+bool HybridMutex::try_lock() {
+  uint32_t c = 0;
+  return state_.compare_exchange_strong(c, 1, std::memory_order_acquire);
+}
+
+void HybridMutex::unlock() {
+  if (state_.exchange(0, std::memory_order_release) == 2) {
+    sys_futex(&state_, FUTEX_WAKE_PRIVATE, 1, nullptr);
+  }
+}
+
+void HybridCondition::wait(HybridMutex& m) {
+  uint32_t seq = seq_.load(std::memory_order_relaxed);
+  m.unlock();
+  sys_futex(&seq_, FUTEX_WAIT_PRIVATE, seq, nullptr);
+  m.lock();
+}
+
+bool HybridCondition::wait_for(HybridMutex& m, int64_t timeout_ns) {
+  uint32_t seq = seq_.load(std::memory_order_relaxed);
+  m.unlock();
+  struct timespec ts;
+  ts.tv_sec = timeout_ns / 1000000000LL;
+  ts.tv_nsec = timeout_ns % 1000000000LL;
+  long rc = sys_futex(&seq_, FUTEX_WAIT_PRIVATE, seq, &ts);
+  m.lock();
+  return rc == 0 || seq_.load(std::memory_order_relaxed) != seq;
+}
+
+void HybridCondition::notify_one() {
+  seq_.fetch_add(1, std::memory_order_relaxed);
+  sys_futex(&seq_, FUTEX_WAKE_PRIVATE, 1, nullptr);
+}
+
+void HybridCondition::notify_all() {
+  seq_.fetch_add(1, std::memory_order_relaxed);
+  sys_futex(&seq_, FUTEX_WAKE_PRIVATE, INT32_MAX, nullptr);
+}
+
+}  // namespace tpulab

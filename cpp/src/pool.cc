@@ -1,0 +1,51 @@
+#include "tpulab/pool.h"
+
+#include <chrono>
+#include <mutex>
+
+namespace tpulab {
+
+TokenPool::TokenPool(size_t) {}
+
+void TokenPool::push(int64_t token) {
+  {
+    std::lock_guard<HybridMutex> lk(mu_);  // exception-safe unlock
+    items_.push_back(token);
+  }
+  cv_.notify_one();
+}
+
+bool TokenPool::pop(int64_t* token, int64_t timeout_ns) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::nanoseconds(timeout_ns < 0 ? 0 : timeout_ns);
+  std::lock_guard<HybridMutex> lk(mu_);  // cv waits unlock/relock internally
+  while (items_.empty()) {
+    if (timeout_ns < 0) {
+      cv_.wait(mu_);
+    } else {
+      auto now = std::chrono::steady_clock::now();
+      if (now >= deadline) return false;
+      cv_.wait_for(mu_, std::chrono::duration_cast<std::chrono::nanoseconds>(
+                            deadline - now)
+                            .count());
+    }
+  }
+  *token = items_.front();
+  items_.pop_front();
+  return true;
+}
+
+bool TokenPool::try_pop(int64_t* token) {
+  std::lock_guard<HybridMutex> lk(mu_);
+  if (items_.empty()) return false;
+  *token = items_.front();
+  items_.pop_front();
+  return true;
+}
+
+size_t TokenPool::size() const {
+  std::lock_guard<HybridMutex> lk(mu_);
+  return items_.size();
+}
+
+}  // namespace tpulab

@@ -1,0 +1,63 @@
+#include "tpulab/thread_pool.h"
+
+#include <pthread.h>
+#include <sched.h>
+
+namespace tpulab {
+
+ThreadPool::ThreadPool(size_t n_threads, const std::vector<int>& cpus) {
+  for (size_t i = 0; i < n_threads; ++i) {
+    int cpu = i < cpus.size() ? cpus[i] : -1;
+    workers_.emplace_back([this, cpu] { worker(cpu); });
+  }
+}
+
+ThreadPool::~ThreadPool() {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    shutdown_ = true;
+  }
+  cv_.notify_all();
+  for (auto& t : workers_) t.join();
+}
+
+void ThreadPool::enqueue(std::function<void()> fn) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    tasks_.push(std::move(fn));
+  }
+  cv_.notify_one();
+}
+
+void ThreadPool::drain() {
+  std::unique_lock<std::mutex> lk(mu_);
+  drain_cv_.wait(lk, [this] { return tasks_.empty() && active_ == 0; });
+}
+
+void ThreadPool::worker(int cpu) {
+  if (cpu >= 0) {
+    cpu_set_t set;
+    CPU_ZERO(&set);
+    CPU_SET(cpu, &set);
+    pthread_setaffinity_np(pthread_self(), sizeof(set), &set);
+  }
+  for (;;) {
+    std::function<void()> task;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [this] { return shutdown_ || !tasks_.empty(); });
+      if (shutdown_ && tasks_.empty()) return;
+      task = std::move(tasks_.front());
+      tasks_.pop();
+      ++active_;
+    }
+    task();
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      --active_;
+      if (tasks_.empty() && active_ == 0) drain_cv_.notify_all();
+    }
+  }
+}
+
+}  // namespace tpulab

@@ -1,0 +1,89 @@
+#include "tpulab/transactional.h"
+
+#include <algorithm>
+
+namespace tpulab {
+
+namespace {
+inline size_t align_up(size_t v, size_t a) { return (v + a - 1) & ~(a - 1); }
+}  // namespace
+
+TransactionalAllocator::TransactionalAllocator(BlockArena* arena,
+                                               size_t max_stacks)
+    : arena_(arena), max_stacks_(max_stacks) {}
+
+TransactionalAllocator::~TransactionalAllocator() {
+  for (Stack* s : stacks_) {
+    arena_->deallocate_block(s->base);
+    delete s;
+  }
+}
+
+TransactionalAllocator::Stack* TransactionalAllocator::rotate_locked() {
+  if (current_) {
+    current_->retired = true;
+    if (current_->refs == 0) release_stack_locked(current_);
+  }
+  if (max_stacks_ && stacks_.size() >= max_stacks_) return nullptr;
+  void* block = arena_->allocate_block();
+  if (!block) return nullptr;
+  Stack* s = new Stack{static_cast<char*>(block)};
+  stacks_.push_back(s);
+  current_ = s;
+  return s;
+}
+
+void TransactionalAllocator::release_stack_locked(Stack* s) {
+  stacks_.erase(std::find(stacks_.begin(), stacks_.end(), s));
+  arena_->deallocate_block(s->base);
+  if (current_ == s) current_ = nullptr;
+  delete s;
+}
+
+// Each allocation carries its owning Stack* in an 8-byte in-band header just
+// before the returned pointer — O(1) deallocate with no hash map on the hot
+// path (the reference reaches the same via its block_manager address lookup).
+
+void* TransactionalAllocator::allocate(size_t size, size_t alignment) {
+  if (size == 0 || size + kHeader + alignment > arena_->block_size())
+    return nullptr;
+  std::lock_guard<std::mutex> lk(mu_);
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    Stack* s = current_;
+    if (!s || s->retired) {
+      s = rotate_locked();
+      if (!s) return nullptr;
+    }
+    uintptr_t base = reinterpret_cast<uintptr_t>(s->base);
+    uintptr_t start = align_up(base + s->cursor + kHeader, alignment);
+    if (start + size <= base + arena_->block_size()) {
+      s->cursor = start + size - base;
+      ++s->refs;
+      reinterpret_cast<Stack**>(start)[-1] = s;
+      return reinterpret_cast<void*>(start);
+    }
+    // current stack can't fit it — rotate and retry once
+    if (!rotate_locked()) return nullptr;
+  }
+  return nullptr;
+}
+
+bool TransactionalAllocator::deallocate(void* ptr) {
+  std::lock_guard<std::mutex> lk(mu_);
+  Stack* s = reinterpret_cast<Stack**>(ptr)[-1];
+  // validate the header against live stacks (guards invalid frees)
+  if (std::find(stacks_.begin(), stacks_.end(), s) == stacks_.end())
+    return false;
+  uintptr_t base = reinterpret_cast<uintptr_t>(s->base);
+  uintptr_t p = reinterpret_cast<uintptr_t>(ptr);
+  if (p < base + kHeader || p > base + arena_->block_size()) return false;
+  if (--s->refs == 0 && s->retired) release_stack_locked(s);
+  return true;
+}
+
+size_t TransactionalAllocator::live_stacks() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return stacks_.size();
+}
+
+}  // namespace tpulab

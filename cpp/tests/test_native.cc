@@ -1,0 +1,140 @@
+// Native core tests (assert-based; ctest target `native`).
+// Mirrors the Python memory-suite semantics for the native implementations.
+#include <cassert>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "tpulab/arena.h"
+#include "tpulab/bfit.h"
+#include "tpulab/hybrid_mutex.h"
+#include "tpulab/pool.h"
+#include "tpulab/thread_pool.h"
+#include "tpulab/transactional.h"
+
+using namespace tpulab;
+
+static void test_arena() {
+  BlockArena arena(4096, 64, 2);
+  void* a = arena.allocate_block();
+  void* b = arena.allocate_block();
+  assert(a && b);
+  assert(arena.allocate_block() == nullptr);  // max_blocks
+  arena.deallocate_block(a);
+  assert(arena.cached_blocks() == 1);
+  void* c = arena.allocate_block();
+  assert(c == a);  // recycled
+  arena.deallocate_block(b);
+  arena.deallocate_block(c);
+  assert(arena.shrink_to_fit() == 2 * 4096);
+  std::printf("arena ok\n");
+}
+
+static void test_transactional() {
+  BlockArena arena(4096);
+  TransactionalAllocator tx(&arena);
+  char* a = static_cast<char*>(tx.allocate(1024));
+  char* b = static_cast<char*>(tx.allocate(1024));
+  // O(1) bump: stride = size + 8B header, 64B-aligned
+  assert(a && b && b == a + 1088);
+  void* c = tx.allocate(3000);      // rotation
+  assert(c && tx.live_stacks() == 2);
+  assert(tx.deallocate(a) && tx.deallocate(b));
+  assert(tx.live_stacks() == 1);    // retired stack drained
+  assert(tx.deallocate(c));
+  assert(tx.allocate(8192) == nullptr);  // oversize
+  std::printf("transactional ok\n");
+}
+
+static void test_transactional_threads() {
+  BlockArena arena(1 << 16);
+  TransactionalAllocator tx(&arena);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 8; ++t) {
+    threads.emplace_back([&tx] {
+      for (int i = 0; i < 1000; ++i) {
+        void* p = tx.allocate(64);
+        assert(p);
+        std::memset(p, 0xab, 64);
+        assert(tx.deallocate(p));
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  std::printf("transactional threads ok\n");
+}
+
+static void test_bfit() {
+  BlockArena arena(1 << 16);
+  BFitAllocator bf(&arena);
+  void* a = bf.allocate(1000);
+  void* b = bf.allocate(2000);
+  void* c = bf.allocate(500);
+  assert(a && b && c);
+  assert(bf.deallocate(b));
+  void* d = bf.allocate(1500);  // best-fit reuses the 2000 hole
+  assert(d == b);
+  assert(bf.deallocate(a) && bf.deallocate(c) && bf.deallocate(d));
+  assert(bf.free_bytes() == (1 << 16));  // fully coalesced
+  assert(bf.live_allocations() == 0);
+  std::printf("bfit ok\n");
+}
+
+static void test_pool() {
+  TokenPool pool;
+  pool.push(7);
+  int64_t tok = 0;
+  assert(pool.pop(&tok) && tok == 7);
+  assert(!pool.pop(&tok, 10'000'000));  // 10ms timeout on empty
+  // producer/consumer
+  std::thread producer([&pool] {
+    for (int i = 0; i < 100; ++i) pool.push(i);
+  });
+  int count = 0;
+  while (count < 100) {
+    assert(pool.pop(&tok, 1'000'000'000));
+    ++count;
+  }
+  producer.join();
+  std::printf("pool ok\n");
+}
+
+static void test_hybrid_mutex() {
+  HybridMutex mu;
+  int counter = 0;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < 8; ++t) {
+    threads.emplace_back([&] {
+      for (int i = 0; i < 10000; ++i) {
+        mu.lock();
+        ++counter;
+        mu.unlock();
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  assert(counter == 80000);
+  std::printf("hybrid mutex ok\n");
+}
+
+static void test_thread_pool() {
+  ThreadPool tp(4);
+  std::atomic<int> done{0};
+  for (int i = 0; i < 100; ++i) tp.enqueue([&done] { ++done; });
+  tp.drain();
+  assert(done == 100);
+  std::printf("thread pool ok\n");
+}
+
+int main() {
+  test_arena();
+  test_transactional();
+  test_transactional_threads();
+  test_bfit();
+  test_pool();
+  test_hybrid_mutex();
+  test_thread_pool();
+  std::printf("ALL NATIVE TESTS PASSED\n");
+  return 0;
+}
