@@ -23,7 +23,9 @@ def main() -> None:
     from tpulab.engine import InferBench, InferenceManager
     from tpulab.models.resnet import make_resnet
     from tpulab.tpu.device_info import DeviceInfo
+    from tpulab.tpu.platform import enable_compilation_cache
 
+    enable_compilation_cache()
     t_start = time.time()
     model = make_resnet(depth=50, max_batch_size=128, input_dtype=np.uint8,
                         batch_buckets=[1, 8, 128])

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""CLI inference benchmark (reference examples/00_TensorRT infer.cc:79-147:
+flags engine/contexts/buffers/seconds/batch_size; pipelined sync loop).
+
+    python examples/00_basic_infer.py --model resnet50 --batch-size 8 \
+        --contexts 4 --buffers 16 --seconds 5 --uint8
+"""
+
+import argparse
+import json
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--engine", default=None,
+                    help="load a serialized engine artifact instead")
+    ap.add_argument("--batch-size", type=int, default=1)
+    ap.add_argument("--contexts", type=int, default=2,
+                    help="max in-flight executions (reference --contexts)")
+    ap.add_argument("--buffers", type=int, default=0,
+                    help="staging bundles (default 2x contexts)")
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--uint8", action="store_true",
+                    help="uint8 ingest path (INT8-engine parity)")
+    ap.add_argument("--latency", action="store_true",
+                    help="also report p50/p90/p99")
+    ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    args = ap.parse_args()
+
+    if args.cpu:
+        from tpulab.tpu.platform import force_cpu
+        force_cpu(1)
+    import numpy as np
+    from tpulab.engine import InferBench, InferenceManager
+    from tpulab.models import build_model
+    from tpulab.tpu.platform import enable_compilation_cache
+
+    enable_compilation_cache()
+    kwargs = dict(max_batch_size=max(args.batch_size, 1))
+    if args.uint8 and args.model.startswith("resnet"):
+        kwargs["input_dtype"] = np.uint8
+    model = build_model(args.model, **kwargs)
+
+    mgr = InferenceManager(max_executions=args.contexts,
+                           max_buffers=args.buffers)
+    mgr.register_model(args.model, model)
+    mgr.update_resources()
+
+    bench = InferBench(mgr)
+    result = bench.run(args.model, batch_size=args.batch_size,
+                       seconds=args.seconds)
+    # reference-style metric table (infer_bench.cc:90-98 keys)
+    for k, v in result.items():
+        print(f"{k:32s} {v:.3f}")
+    if args.latency:
+        for k, v in bench.latency(args.model,
+                                  batch_size=args.batch_size).items():
+            print(f"{k:32s} {v:.3f}")
+    print(json.dumps({"inf/sec": result["inferences_per_second"]}))
+    mgr.shutdown()
+
+
+if __name__ == "__main__":
+    main()

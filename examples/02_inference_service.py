@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Flagship gRPC inference service (reference examples/02_TensorRT_GRPC
+server.cc:82-331): model serving + Prometheus metrics (request/compute
+duration quantiles, load-ratio histogram, HBM gauge polled from the server
+control lambda) + optional dynamic batching.
+
+    python examples/02_inference_service.py --model resnet50 --uint8 \
+        --port 50051 --metrics-port 9090 --batching
+"""
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--port", type=int, default=50051)
+    ap.add_argument("--metrics-port", type=int, default=9090)
+    ap.add_argument("--contexts", type=int, default=4)
+    ap.add_argument("--max-batch-size", type=int, default=128)
+    ap.add_argument("--batching", action="store_true")
+    ap.add_argument("--batch-window-ms", type=float, default=5.0)
+    ap.add_argument("--uint8", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        from tpulab.tpu.platform import force_cpu
+        force_cpu(1)
+    import numpy as np
+    import tpulab
+    from tpulab.models import build_model
+    from tpulab.tpu.platform import enable_compilation_cache
+    from tpulab.utils.metrics import InferenceMetrics, start_metrics_server
+
+    enable_compilation_cache()
+    kwargs = dict(max_batch_size=args.max_batch_size)
+    if args.uint8 and args.model.startswith("resnet"):
+        kwargs["input_dtype"] = np.uint8
+    model = build_model(args.model, **kwargs)
+
+    metrics = InferenceMetrics()
+    start_metrics_server(metrics, args.metrics_port)
+
+    mgr = tpulab.InferenceManager(max_exec_concurrency=args.contexts)
+    mgr.register_model(args.model, model)
+    mgr.update_resources()
+    mgr.serve(port=args.port, batching=args.batching,
+              batch_window_s=args.batch_window_ms / 1000.0, metrics=metrics)
+    print(f"serving {args.model} on :{args.port}, metrics on "
+          f":{args.metrics_port}/metrics", flush=True)
+    # control lambda: HBM gauge every 2s (reference NVML power gauge,
+    # server.cc:322-331)
+    mgr.server.run(control_fn=metrics.poll_device, control_period_s=2.0)
+
+
+if __name__ == "__main__":
+    main()

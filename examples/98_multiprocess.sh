@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# N server processes + round-robin client (reference examples/98: N processes
+# sharing a V100 via CUDA MPS + envoy).  TPU note: chips are not MPS-shared —
+# on a pod VM each process binds its own chip (TPU_VISIBLE_DEVICES); on a
+# single-chip host this script still demonstrates the N-replica topology.
+#
+#   ./98_multiprocess.sh 2 resnet50
+set -euo pipefail
+N=${1:-2}
+MODEL=${2:-mnist}
+BASE_PORT=51000
+PIDS=()
+
+cleanup() { kill "${PIDS[@]}" 2>/dev/null || true; }
+trap cleanup EXIT
+
+for i in $(seq 0 $((N-1))); do
+  PORT=$((BASE_PORT + i))
+  TPU_VISIBLE_DEVICES=$i python "$(dirname "$0")/02_inference_service.py" \
+      --model "$MODEL" --port "$PORT" --metrics-port $((9100 + i)) &
+  PIDS+=($!)
+  echo "replica $i on :$PORT (pid ${PIDS[-1]})"
+done
+
+echo "waiting for replicas..."
+for i in $(seq 0 $((N-1))); do
+  until python - <<EOF 2>/dev/null
+from tpulab.rpc.infer_service import RemoteInferenceManager
+RemoteInferenceManager("localhost:$((BASE_PORT + i))").get_models()
+EOF
+  do sleep 2; done
+done
+
+echo "driving round-robin load across $N replicas"
+python - <<EOF
+import numpy as np, time
+from tpulab.rpc.infer_service import RemoteInferenceManager
+remotes = [RemoteInferenceManager(f"localhost:{$BASE_PORT + i}")
+           for i in range($N)]
+runners = [r.infer_runner("$MODEL") for r in remotes]
+spec = remotes[0].get_models()["$MODEL"].inputs[0]
+x = np.zeros((1, *spec.dims), np.dtype(spec.dtype))
+futs = [runners[i % $N].infer(**{spec.name: x}) for i in range(200)]
+t0 = time.perf_counter()
+[f.result(timeout=300) for f in futs]
+print(f"200 requests over $N replicas: {200/(time.perf_counter()-t0):.1f} inf/s")
+EOF

@@ -1,0 +1,15 @@
+"""Load example scripts as modules (their filenames start with digits)."""
+
+import importlib.util
+import os
+
+_EX = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "examples")
+
+
+def load_example(name: str):
+    spec = importlib.util.spec_from_file_location(
+        f"example_{name}", os.path.join(_EX, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

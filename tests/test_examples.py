@@ -1,0 +1,96 @@
+"""Example smoke tests (hermetic CPU): the quickstart flow, the CLI bench,
+the echo service, and the batching middleman end-to-end."""
+
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+def test_30_python_api_quickstart():
+    """The notebook flow runs end to end (golden check inside)."""
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "TPULAB_FORCE_CPU": "1", "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from tpulab.tpu.platform import force_cpu; force_cpu(1);"
+         "import runpy; runpy.run_path("
+         f"'{REPO}/examples/30_python_api.py', run_name='__main__')"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "remote == local: OK" in out.stdout
+
+
+def test_01_echo_service_loopback():
+    from examples_helpers import load_example
+    mod = load_example("01_basic_grpc")
+    from tpulab.rpc import ClientExecutor, ClientUnary, Executor, Server
+    from tpulab.rpc.server import AsyncService
+    server = Server("127.0.0.1:0", Executor(n_threads=2))
+    svc = AsyncService(mod.SERVICE)
+    svc.register_rpc("Echo", mod.EchoContext)
+    server.register_async_service(svc)
+    server.async_start()
+    server.wait_until_running()
+    try:
+        with ClientExecutor(f"127.0.0.1:{server.bound_port}") as cx:
+            unary = ClientUnary(cx, f"/{mod.SERVICE}/Echo")
+            assert unary.call(b"ping", timeout=10) == b"ping"
+    finally:
+        server.shutdown()
+
+
+def test_03_middleman_batches_to_backend():
+    """client -> middleman (aggregating) -> backend service."""
+    import tpulab
+    from examples_helpers import load_example
+    from tpulab.models.mnist import make_mnist
+    from tpulab.rpc import AsyncService, Executor, Server
+    from tpulab.rpc.infer_service import (SERVICE_NAME,
+                                          RemoteInferenceManager)
+    from tpulab.rpc.protos import inference_pb2 as pb
+
+    backend = tpulab.InferenceManager(max_exec_concurrency=2)
+    backend.register_model("mnist", make_mnist(max_batch_size=8))
+    backend.update_resources()
+    backend.serve(port=0)
+
+    mod = load_example("03_batching_middleman")
+    forwarder = mod.BatchingForwarder(
+        f"localhost:{backend.server.bound_port}", max_batch=8, window_s=0.02)
+
+    class ForwardContext(mod.Context):
+        def execute_rpc(self, request):
+            return forwarder.infer(request)
+
+    mm = Server("127.0.0.1:0", Executor(n_threads=8))
+    svc = AsyncService(SERVICE_NAME)
+    svc.register_rpc("Infer", ForwardContext, pb.InferRequest.FromString,
+                     pb.InferResponse.SerializeToString)
+    mm.register_async_service(svc)
+    mm.async_start()
+    mm.wait_until_running()
+    try:
+        from tpulab.rpc.client import ClientExecutor, ClientUnary
+        from tpulab.rpc.infer_service import proto_to_tensor, tensor_to_proto
+        with ClientExecutor(f"127.0.0.1:{mm.bound_port}") as cx:
+            infer = ClientUnary(cx, f"/{SERVICE_NAME}/Infer",
+                                pb.InferRequest.SerializeToString,
+                                pb.InferResponse.FromString)
+            x = np.zeros((1, 28, 28, 1), np.float32)
+            req = pb.InferRequest(model_name="mnist", batch_size=1)
+            req.inputs.append(tensor_to_proto("Input3", x))
+            futs = [infer.start(req) for _ in range(8)]
+            resps = [f.result(timeout=60) for f in futs]
+            assert all(r.status.code == pb.SUCCESS for r in resps)
+            out = proto_to_tensor(resps[0].outputs[0])
+            assert out.shape == (1, 10)
+    finally:
+        mm.shutdown()
+        backend.shutdown()

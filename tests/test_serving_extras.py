@@ -1,0 +1,197 @@
+"""Tests: server-side dynamic batching, metrics, torch weight import."""
+
+import time
+
+import numpy as np
+import pytest
+
+import tpulab
+from tpulab.engine import InferenceManager
+from tpulab.engine.batched_runner import BatchedInferRunner
+from tpulab.models.mnist import make_mnist
+
+
+# ----------------------------------------------------------- batched runner --
+@pytest.fixture(scope="module")
+def mgr():
+    m = InferenceManager(max_executions=2, max_buffers=8)
+    m.register_model("mnist", make_mnist(max_batch_size=8))
+    m.update_resources()
+    yield m
+    m.shutdown()
+
+
+def test_batched_runner_aggregates(mgr):
+    runner = BatchedInferRunner(mgr, "mnist", window_s=0.05)
+    try:
+        x = np.random.default_rng(0).standard_normal((1, 28, 28, 1)).astype(np.float32)
+        futs = [runner.infer(Input3=x) for _ in range(8)]  # closes by size
+        outs = [f.result(timeout=60) for f in futs]
+        assert all(o["Plus214_Output_0"].shape == (1, 10) for o in outs)
+        # every caller gets identical rows for identical inputs
+        for o in outs[1:]:
+            np.testing.assert_allclose(o["Plus214_Output_0"],
+                                       outs[0]["Plus214_Output_0"], rtol=1e-5)
+    finally:
+        runner.shutdown()
+
+
+def test_batched_runner_matches_unbatched(mgr):
+    """Numerics: batched path == direct path per request."""
+    runner = BatchedInferRunner(mgr, "mnist", window_s=0.02)
+    try:
+        rng = np.random.default_rng(1)
+        xs = [rng.standard_normal((1, 28, 28, 1)).astype(np.float32)
+              for _ in range(4)]
+        futs = [runner.infer(Input3=x) for x in xs]
+        batched = [f.result(timeout=60) for f in futs]
+        for x, out in zip(xs, batched):
+            direct = mgr.infer_runner("mnist").infer(Input3=x).result(timeout=60)
+            np.testing.assert_allclose(out["Plus214_Output_0"],
+                                       direct["Plus214_Output_0"],
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        runner.shutdown()
+
+
+def test_batched_runner_window_timeout(mgr):
+    runner = BatchedInferRunner(mgr, "mnist", window_s=0.02)
+    try:
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        out = runner.infer(Input3=x).result(timeout=30)  # lone request
+        assert out["Plus214_Output_0"].shape == (1, 10)
+    finally:
+        runner.shutdown()
+
+
+def test_batched_runner_mixed_batch_sizes(mgr):
+    runner = BatchedInferRunner(mgr, "mnist", window_s=0.03)
+    try:
+        f1 = runner.infer(Input3=np.ones((3, 28, 28, 1), np.float32))
+        f2 = runner.infer(Input3=np.ones((2, 28, 28, 1), np.float32))
+        o1, o2 = f1.result(timeout=30), f2.result(timeout=30)
+        assert o1["Plus214_Output_0"].shape == (3, 10)
+        assert o2["Plus214_Output_0"].shape == (2, 10)
+    finally:
+        runner.shutdown()
+
+
+def test_batched_runner_overflow_flushes(mgr):
+    """A request that would overflow the open batch flushes it first."""
+    runner = BatchedInferRunner(mgr, "mnist", window_s=5.0)  # long window
+    try:
+        f1 = runner.infer(Input3=np.ones((5, 28, 28, 1), np.float32))
+        f2 = runner.infer(Input3=np.ones((6, 28, 28, 1), np.float32))
+        # f1's group was flushed by f2's arrival despite the long window
+        assert f1.result(timeout=30)["Plus214_Output_0"].shape == (5, 10)
+        runner.flush()
+        assert f2.result(timeout=30)["Plus214_Output_0"].shape == (6, 10)
+    finally:
+        runner.shutdown()
+
+
+# -------------------------------------------------------- batching service --
+def test_serve_with_batching_enabled():
+    mgr = tpulab.InferenceManager(max_exec_concurrency=2)
+    mgr.register_model("mnist", make_mnist(max_batch_size=8))
+    mgr.update_resources()
+    mgr.serve(port=0, batching=True, batch_window_s=0.02)
+    from tpulab.rpc.infer_service import RemoteInferenceManager
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        runner = remote.infer_runner("mnist")
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        futs = [runner.infer(Input3=x) for _ in range(12)]
+        outs = [f.result(timeout=60) for f in futs]
+        assert all(o["Plus214_Output_0"].shape == (1, 10) for o in outs)
+    finally:
+        remote.close()
+        mgr.shutdown()
+
+
+# ------------------------------------------------------------------ metrics --
+def test_inference_metrics_observations():
+    from tpulab.utils.metrics import InferenceMetrics, LOAD_RATIO_BUCKETS
+    m = InferenceMetrics(namespace="test")
+    for i in range(50):
+        m.observe_request(request_s=0.010 + i * 1e-4, compute_s=0.008)
+    from prometheus_client import generate_latest
+    text = generate_latest(m.registry).decode()
+    assert "test_request_total 50.0" in text
+    assert 'test_request_duration_seconds{quantile="0.5"}' in text
+    assert "test_load_ratio_bucket" in text
+    m.inc_queue_depth(); m.dec_queue_depth()
+    m.poll_device()  # no HBM stats on CPU — must not raise
+
+
+def test_metrics_wired_into_service():
+    from tpulab.utils.metrics import InferenceMetrics
+    from prometheus_client import generate_latest
+    metrics = InferenceMetrics(namespace="svc")
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=2))
+    mgr.update_resources()
+    mgr.serve(port=0, metrics=metrics)
+    from tpulab.rpc.infer_service import RemoteInferenceManager
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        runner = remote.infer_runner("mnist")
+        runner.infer(Input3=np.zeros((1, 28, 28, 1), np.float32)).result(timeout=30)
+        text = generate_latest(metrics.registry).decode()
+        assert "svc_request_total 1.0" in text
+    finally:
+        remote.close()
+        mgr.shutdown()
+
+
+# --------------------------------------------------------------- torch zoo --
+def test_torch_resnet_import_roundtrip():
+    """Build a torch-style ResNet50 state_dict and import it; BN must fold
+    exactly (conv+BN == conv*scale+bias)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as nn
+
+    # minimal torchvision-layout resnet50 state_dict (random weights)
+    sd = {}
+    rng = np.random.default_rng(0)
+
+    def add_conv_bn(prefix_c, prefix_b, cout, cin, k):
+        sd[f"{prefix_c}.weight"] = torch.tensor(
+            rng.standard_normal((cout, cin, k, k)).astype(np.float32) * 0.05)
+        sd[f"{prefix_b}.weight"] = torch.tensor(
+            1 + rng.standard_normal(cout).astype(np.float32) * 0.1)
+        sd[f"{prefix_b}.bias"] = torch.tensor(
+            rng.standard_normal(cout).astype(np.float32) * 0.1)
+        sd[f"{prefix_b}.running_mean"] = torch.tensor(
+            rng.standard_normal(cout).astype(np.float32) * 0.1)
+        sd[f"{prefix_b}.running_var"] = torch.tensor(
+            np.abs(1 + rng.standard_normal(cout).astype(np.float32) * 0.1))
+
+    add_conv_bn("conv1", "bn1", 64, 3, 7)
+    cin = 64
+    for stage, blocks in enumerate([3, 4, 6, 3]):
+        cmid = 64 * 2 ** stage
+        cout = cmid * 4
+        for b in range(blocks):
+            pre = f"layer{stage + 1}.{b}"
+            add_conv_bn(f"{pre}.conv1", f"{pre}.bn1", cmid, cin, 1)
+            add_conv_bn(f"{pre}.conv2", f"{pre}.bn2", cmid, cmid, 3)
+            add_conv_bn(f"{pre}.conv3", f"{pre}.bn3", cout, cmid, 1)
+            if b == 0:
+                add_conv_bn(f"{pre}.downsample.0", f"{pre}.downsample.1",
+                            cout, cin, 1)
+            cin = cout
+    sd["fc.weight"] = torch.tensor(
+        rng.standard_normal((1000, 2048)).astype(np.float32) * 0.01)
+    sd["fc.bias"] = torch.tensor(np.zeros(1000, np.float32))
+
+    from tpulab.models.torch_import import make_resnet_from_torch
+    import jax.numpy as jnp
+    model = make_resnet_from_torch(sd, depth=50, max_batch_size=1,
+                                   compute_dtype=jnp.float32)
+    assert model.params["stem"]["kernel"].shape == (7, 7, 3, 64)
+    assert "proj" in model.params["s0b0"] and "proj" not in model.params["s0b1"]
+    # forward runs and is finite
+    x = {"input": np.zeros((1, 224, 224, 3), np.float32)}
+    out = model.apply_fn(model.params, x)["logits"]
+    assert np.isfinite(np.asarray(out)).all()

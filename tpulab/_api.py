@@ -31,13 +31,17 @@ class InferenceManager(_EngineManager):
         self._server = None
 
     def serve(self, port: int = 50051, wait: bool = False,
-              executor=None) -> "InferenceManager":
+              executor=None, batching: bool = False,
+              batch_window_s: float = 0.002,
+              metrics=None) -> "InferenceManager":
         """Expose registered models over the TRTIS-style gRPC service
-        (reference manager.serve() -> BasicInferService)."""
+        (reference manager.serve() -> BasicInferService).  ``batching=True``
+        enables server-side dynamic batching across concurrent callers."""
         if not self._allocated:
             self.update_resources()
         self._server = build_infer_service(
-            self, f"0.0.0.0:{port}", executor=executor)
+            self, f"0.0.0.0:{port}", executor=executor, batching=batching,
+            batch_window_s=batch_window_s, metrics=metrics)
         if wait:
             self._server.run()
         else:
@@ -51,7 +55,10 @@ class InferenceManager(_EngineManager):
 
     def shutdown(self) -> None:
         if self._server is not None:
+            res = getattr(self._server, "_infer_resources", None)
             self._server.shutdown()
+            if res is not None:
+                res.shutdown()
             self._server = None
         super().shutdown()
 

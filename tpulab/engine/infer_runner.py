@@ -78,7 +78,17 @@ class InferRunner:
                 # (reference post stage ctx sync-then-reset, infer_runner.h:93);
                 # D2H rides the coalescing TransferEngine and the post stage
                 # chains on its future — post threads never block on fetches.
-                poller.watch(outputs, managed.release)
+                import time as _time
+                t_dispatch = _time.monotonic()
+
+                def _compute_done(b=bindings, m=managed, t0=t_dispatch):
+                    # device-side compute duration, measured at the compute
+                    # site (metrics: the reference's per-stage cudaEvent
+                    # timing analog)
+                    b.compute_seconds = _time.monotonic() - t0
+                    m.release()
+
+                poller.watch(outputs, _compute_done)
                 fetch = engine.fetch(outputs)
                 fetch.add_done_callback(
                     lambda f: self._mgr.workers("post").enqueue(

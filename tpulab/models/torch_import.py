@@ -1,0 +1,82 @@
+"""Import pretrained torch ResNet weights into tpulab's jax ResNet.
+
+Engine-building tooling parity (reference examples/ONNX/resnet50/build.py +
+models/onnx_builder.py build real engines from model-zoo artifacts): this
+maps a torchvision-layout ``state_dict`` (``conv1.weight``,
+``layer{1-4}.{b}.conv{1-3}.weight``, ``bn*`` stats, ``fc.*``) onto
+:func:`tpulab.models.resnet.init_resnet_params`' layout, folding each
+BatchNorm into the conv's scale/bias:
+
+    scale = gamma / sqrt(var + eps);  bias = beta - mean * scale
+
+so the serving graph stays the folded conv+scale+bias form.  Weights convert
+OIHW -> HWIO (NHWC serving layout).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+STAGE_BLOCKS = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+BN_EPS = 1e-5
+
+
+def _fold_bn(sd: Mapping[str, Any], conv_key: str, bn_key: str) -> Dict[str, np.ndarray]:
+    w = np.asarray(sd[f"{conv_key}.weight"], np.float32)      # OIHW
+    gamma = np.asarray(sd[f"{bn_key}.weight"], np.float32)
+    beta = np.asarray(sd[f"{bn_key}.bias"], np.float32)
+    mean = np.asarray(sd[f"{bn_key}.running_mean"], np.float32)
+    var = np.asarray(sd[f"{bn_key}.running_var"], np.float32)
+    scale = gamma / np.sqrt(var + BN_EPS)
+    bias = beta - mean * scale
+    return {
+        "kernel": np.transpose(w, (2, 3, 1, 0)),              # -> HWIO
+        "scale": scale,
+        "bias": bias,
+    }
+
+
+def resnet_params_from_torch(state_dict: Mapping[str, Any],
+                             depth: int = 50) -> Dict[str, Any]:
+    """torchvision ResNet state_dict -> tpulab resnet params pytree."""
+    if depth not in STAGE_BLOCKS:
+        raise ValueError(f"unsupported depth {depth}")
+    sd = state_dict
+    params: Dict[str, Any] = {"stem": _fold_bn(sd, "conv1", "bn1")}
+    for stage, blocks in enumerate(STAGE_BLOCKS[depth]):
+        for block in range(blocks):
+            prefix = f"layer{stage + 1}.{block}"
+            p = {
+                "conv1": _fold_bn(sd, f"{prefix}.conv1", f"{prefix}.bn1"),
+                "conv2": _fold_bn(sd, f"{prefix}.conv2", f"{prefix}.bn2"),
+                "conv3": _fold_bn(sd, f"{prefix}.conv3", f"{prefix}.bn3"),
+            }
+            if f"{prefix}.downsample.0.weight" in sd:
+                p["proj"] = _fold_bn(sd, f"{prefix}.downsample.0",
+                                     f"{prefix}.downsample.1")
+            params[f"s{stage}b{block}"] = p
+    params["fc"] = {
+        "kernel": np.asarray(sd["fc.weight"], np.float32).T,
+        "bias": np.asarray(sd["fc.bias"], np.float32),
+    }
+    return params
+
+
+def make_resnet_from_torch(state_dict_or_path, depth: int = 50,
+                           **make_kwargs):
+    """Build a servable Model from a torch checkpoint (path or state_dict)."""
+    if isinstance(state_dict_or_path, (str, bytes)):
+        import torch
+        state_dict = torch.load(state_dict_or_path, map_location="cpu",
+                                weights_only=True)
+    else:
+        state_dict = state_dict_or_path
+    if hasattr(next(iter(state_dict.values())), "detach"):
+        state_dict = {k: v.detach().cpu().numpy()
+                      for k, v in state_dict.items()}
+    from tpulab.models.resnet import make_resnet
+    model = make_resnet(depth=depth, **make_kwargs)
+    model.params = resnet_params_from_torch(state_dict, depth)
+    return model

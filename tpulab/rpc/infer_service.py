@@ -41,11 +41,35 @@ def proto_to_tensor(t: pb.TensorProto) -> np.ndarray:
 
 
 class InferResources(Resources):
-    """Service resources: the InferenceManager (reference TestResources
-    pattern — Resources bundle handed to contexts)."""
+    """Service resources: manager + optional batched runners + metrics
+    (reference Resources bundle handed to contexts)."""
 
-    def __init__(self, manager):
+    def __init__(self, manager, batching: bool = False,
+                 batch_window_s: float = 0.002, metrics=None):
         self.manager = manager
+        self.metrics = metrics
+        self.batching = batching
+        self._batch_window_s = batch_window_s
+        self._batched: Dict[str, object] = {}
+        self._lock = __import__("threading").Lock()
+
+    def runner(self, model_name: str):
+        """Per-model runner; the batched variant aggregates concurrent
+        requests into one device batch (examples/03 capability, in-process)."""
+        if not self.batching:
+            return self.manager.infer_runner(model_name)
+        with self._lock:
+            if model_name not in self._batched:
+                from tpulab.engine.batched_runner import BatchedInferRunner
+                self._batched[model_name] = BatchedInferRunner(
+                    self.manager, model_name, window_s=self._batch_window_s)
+            return self._batched[model_name]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for r in self._batched.values():
+                r.shutdown()
+            self._batched.clear()
 
 
 class StatusContext(Context):
@@ -111,14 +135,23 @@ class InferContext(Context):
             resp.status.code = pb.INVALID_ARGUMENT
             resp.status.message = str(e)
             return resp
+        res = self.get_resources(InferResources)
         try:
-            runner = mgr.infer_runner(request.model_name)
+            import time as _time
+            runner = res.runner(request.model_name)
+            t0 = _time.monotonic()
             outputs = runner.infer(**arrays).result()
+            # prefer the compute-site measurement (device dispatch -> ready);
+            # the wait-time fallback includes queueing/window (see runner)
+            compute_s = (getattr(runner, "last_compute_s", None)
+                         or (_time.monotonic() - t0))
             wanted = set(request.requested_outputs) or set(outputs)
             for name, arr in outputs.items():
                 if name in wanted:
                     resp.outputs.append(tensor_to_proto(name, arr))
             resp.status.code = pb.SUCCESS
+            if res.metrics is not None:
+                res.metrics.observe_request(self.walltime(), compute_s)
         except Exception as e:  # noqa: BLE001
             log.exception("inference failed")
             resp.status.code = pb.INTERNAL
@@ -133,11 +166,20 @@ class HealthContext(Context):
 
 
 def build_infer_service(manager, address: str = "0.0.0.0:0",
-                        executor: Optional[Executor] = None) -> Server:
+                        executor: Optional[Executor] = None,
+                        batching: bool = False,
+                        batch_window_s: float = 0.002,
+                        metrics=None) -> Server:
     """Wire the inference service onto a Server
-    (reference BasicInferService ctor infer.cc:644-678)."""
-    resources = InferResources(manager)
+    (reference BasicInferService ctor infer.cc:644-678).
+
+    ``batching=True`` turns on server-side dynamic batching: concurrent unary
+    Infer calls aggregate into one device batch per model (examples/03's
+    middleman capability, in-process)."""
+    resources = InferResources(manager, batching=batching,
+                               batch_window_s=batch_window_s, metrics=metrics)
     server = Server(address, executor or Executor(n_threads=4))
+    server._infer_resources = resources  # for shutdown
     service = AsyncService(SERVICE_NAME, resources)
     service.register_rpc("Status", StatusContext,
                          pb.StatusRequest.FromString,

@@ -111,7 +111,8 @@ class Server:
             control_period_s: float = 2.0) -> None:
         """Serve until shutdown; runs control_fn every period
         (reference Server::Run(timeout, control_fn))."""
-        self.async_start()
+        if not self._running.is_set():  # idempotent after async_start()
+            self.async_start()
         try:
             while not self._stop.wait(timeout=control_period_s):
                 if control_fn is not None:
@@ -158,8 +159,12 @@ class Server:
     # -- sync (thread Executor) ----------------------------------------------
     def _start_sync(self) -> None:
         ex = self.executor
+        # blocking handlers need a worker each while in flight — size the
+        # pool to the pre-armed-context bound (reference contexts_per_thread),
+        # capped to keep thread count sane
         pool = _futures.ThreadPoolExecutor(
-            max_workers=max(ex.n_threads, 4), thread_name_prefix="rpc")
+            max_workers=max(ex.n_threads, min(ex.max_concurrency, 128)),
+            thread_name_prefix="rpc")
         self._worker_pool = pool
         self._server = grpc.server(
             pool, maximum_concurrent_rpcs=ex.max_concurrency)

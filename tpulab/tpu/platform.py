@@ -53,6 +53,19 @@ def process_count() -> int:
     return _jax().process_count()
 
 
+def enable_compilation_cache(path: str = "") -> None:
+    """Persistent XLA compilation cache — the runtime side of the AOT-engine
+    story: recompiles of the same program/topology become disk hits, so
+    server restarts skip the cold-compile (the TRT 'deserialize plan' UX).
+    """
+    jax = _jax()
+    cache_dir = path or os.environ.get(
+        "TPULAB_COMPILE_CACHE", os.path.expanduser("~/.cache/tpulab/xla"))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 def force_cpu(n_devices: int = 8) -> None:
     """Hermetic-test hook: route JAX to N virtual CPU devices.
 

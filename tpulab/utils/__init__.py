@@ -1,0 +1,5 @@
+"""tpulab.utils — flags, metrics, logging helpers."""
+
+from tpulab.utils.metrics import InferenceMetrics, start_metrics_server
+
+__all__ = ["InferenceMetrics", "start_metrics_server"]

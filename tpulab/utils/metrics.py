@@ -1,0 +1,133 @@
+"""Prometheus metrics (reference examples/02 metrics.h/cc:27-107 — singleton
+Exposer+Registry; compute/request duration summaries with p50/p90/p99
+quantiles; load-ratio histogram {1.25,1.5,2,10,100}; device power gauge
+polled from Server::Run's control lambda).
+
+prometheus_client has no quantile Summary, so the duration summaries are
+implemented the way the reference's consumers read them: sliding-window
+reservoirs exported as per-quantile gauges, next to total count/sum counters.
+The NVML power gauge's TPU analog is the HBM usage gauge (polled from the
+server control lambda via :meth:`InferenceMetrics.poll_device`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+try:
+    from prometheus_client import (CollectorRegistry, Counter, Gauge,
+                                   Histogram, start_http_server)
+    HAVE_PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    HAVE_PROMETHEUS = False
+
+#: reference load-ratio buckets (metrics.cc): request_time / compute_time
+LOAD_RATIO_BUCKETS = (1.25, 1.5, 2.0, 10.0, 100.0)
+
+_QUANTILES = (0.5, 0.9, 0.99)
+
+
+class _Reservoir:
+    """Sliding-window quantile reservoir backing a 'summary'."""
+
+    def __init__(self, size: int = 2048):
+        self._buf = np.zeros(size, np.float64)
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._buf[self._n % len(self._buf)] = value
+            self._n += 1
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            n = min(self._n, len(self._buf))
+            if n == 0:
+                return 0.0
+            return float(np.percentile(self._buf[:n], q * 100))
+
+
+class InferenceMetrics:
+    """The example-02 metric set for one service."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self._request = _Reservoir()
+        self._compute = _Reservoir()
+        self.request_count = Counter(
+            f"{ns}_request_total", "Requests completed", registry=self.registry)
+        # Gauges (not Counters) so the exported sample keeps the summary
+        # convention `..._seconds_sum` — Counter would append `_total`.
+        self.request_seconds_sum = Gauge(
+            f"{ns}_request_duration_seconds_sum", "Total request seconds",
+            registry=self.registry)
+        self.compute_seconds_sum = Gauge(
+            f"{ns}_compute_duration_seconds_sum", "Total compute seconds",
+            registry=self.registry)
+        self.request_quantiles = Gauge(
+            f"{ns}_request_duration_seconds", "Request duration quantiles",
+            ["quantile"], registry=self.registry)
+        self.compute_quantiles = Gauge(
+            f"{ns}_compute_duration_seconds", "Compute duration quantiles",
+            ["quantile"], registry=self.registry)
+        self.load_ratio = Histogram(
+            f"{ns}_load_ratio", "request/compute duration ratio",
+            buckets=LOAD_RATIO_BUCKETS, registry=self.registry)
+        self.hbm_bytes_in_use = Gauge(
+            f"{ns}_hbm_bytes_in_use", "Device HBM in use (power-gauge analog)",
+            registry=self.registry)
+        self.queue_depth = Gauge(
+            f"{ns}_queue_depth", "In-flight requests (NVRPC_METRICS hook)",
+            registry=self.registry)
+
+    # -- observation hooks ---------------------------------------------------
+    _REFRESH_EVERY = 64  # quantile refresh cadence (full reservoir sort)
+
+    def observe_request(self, request_s: float, compute_s: float) -> None:
+        self.request_count.inc()
+        self.request_seconds_sum.inc(request_s)
+        self.compute_seconds_sum.inc(compute_s)
+        self._request.observe(request_s)
+        self._compute.observe(compute_s)
+        if compute_s > 0:
+            self.load_ratio.observe(request_s / compute_s)
+        # quantile gauges refresh periodically (and from the control lambda),
+        # not per request — the sort is too heavy for the hot path
+        self._since_refresh = getattr(self, "_since_refresh", 0) + 1
+        if self._since_refresh == 1 or self._since_refresh >= self._REFRESH_EVERY:
+            self.refresh_quantiles()
+
+    def refresh_quantiles(self) -> None:
+        self._since_refresh = 0
+        for q in _QUANTILES:
+            self.request_quantiles.labels(quantile=str(q)).set(
+                self._request.quantile(q))
+            self.compute_quantiles.labels(quantile=str(q)).set(
+                self._compute.quantile(q))
+
+    def inc_queue_depth(self) -> None:
+        self.queue_depth.inc()
+
+    def dec_queue_depth(self) -> None:
+        self.queue_depth.dec()
+
+    def poll_device(self, device_index: int = 0) -> None:
+        """Control-lambda hook (reference NVML power gauge in Server::Run)."""
+        from tpulab.tpu.device_info import DeviceInfo
+        info = DeviceInfo.memory_info(device_index)
+        if info.bytes_in_use is not None:
+            self.hbm_bytes_in_use.set(info.bytes_in_use)
+        self.refresh_quantiles()  # scrape-freshness without hot-path sorts
+
+
+def start_metrics_server(metrics: InferenceMetrics, port: int = 9090):
+    """Expose /metrics (reference Exposer on :8080)."""
+    return start_http_server(port, registry=metrics.registry)
