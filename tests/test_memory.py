@@ -469,3 +469,44 @@ def test_detach_after_release_raises():
     d.release()
     with pytest.raises(Exception):
         d.detach()
+
+
+# ------------------------------------------------------------ shared memory --
+def test_shared_memory_cross_process():
+    """Producer process fills a named segment; we read it zero-copy
+    (reference SysV shm ingress, examples/02 server.cc:110-137)."""
+    import subprocess
+    import sys
+    from tpulab.memory.shm import SharedMemoryAllocator
+
+    alloc = SharedMemoryAllocator()
+    addr = alloc.allocate_node(4096)
+    name = alloc.segment_name(addr)
+    code = (
+        "from tpulab.memory.shm import SharedMemoryAllocator;"
+        f"seg = SharedMemoryAllocator.attach('{name}');"
+        "seg.numpy()[:8] = list(range(8)); seg.close()"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True, timeout=60)
+    view = alloc.view(addr, 4096)
+    assert bytes(view[:8]) == bytes(range(8))
+    alloc.deallocate_node(addr)
+
+
+def test_shared_memory_attach_and_descriptors():
+    from tpulab.memory.allocator import make_allocator
+    from tpulab.memory.shm import SharedMemoryAllocator
+
+    alloc_raw = SharedMemoryAllocator()
+    alloc = make_allocator(alloc_raw)
+    d = alloc.allocate_descriptor(1024)
+    arr = d.numpy(np.float32, (256,))
+    arr[:] = 2.5
+    with SharedMemoryAllocator.attach(
+            alloc_raw.segment_name(d.addr)) as seg:
+        peer = seg.numpy(np.float32, (256,))
+        assert peer.sum() == 640.0
+    d.release()
+    with pytest.raises(Exception):
+        alloc_raw.deallocate_node(0x1234)
+    alloc_raw.close()

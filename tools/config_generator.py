@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Generate a serving ModelConfig from a model or engine artifact
+(reference examples/12_ConfigGenerator generator.cc:28-60: TRTIS ModelConfig
+from a TRT engine).
+
+    python tools/config_generator.py --model resnet50 --max-batch 128
+    python tools/config_generator.py --engine path/to/engine_dir
+"""
+
+import argparse
+import json
+import sys
+
+
+def model_config(model, instances: int = 1) -> dict:
+    """TRTIS-style model_config dict from a tpulab Model."""
+    return {
+        "name": model.name,
+        "platform": "tpulab_xla",
+        "max_batch_size": model.max_batch_size,
+        "batch_buckets": list(model.batch_buckets),
+        "input": [
+            {"name": s.name, "data_type": s.np_dtype.name,
+             "dims": list(s.shape)} for s in model.inputs
+        ],
+        "output": [
+            {"name": s.name, "data_type": s.np_dtype.name,
+             "dims": list(s.shape)} for s in model.outputs
+        ],
+        "instance_group": [{"count": instances, "kind": "KIND_TPU"}],
+        "dynamic_batching": {
+            "preferred_batch_size": list(model.batch_buckets),
+            "max_queue_delay_microseconds": 2000,
+        },
+        "weights_bytes": model.weights_size_in_bytes(),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", help="registry model name")
+    ap.add_argument("--engine", help="engine artifact directory")
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--instances", type=int, default=1)
+    args = ap.parse_args()
+
+    from tpulab.tpu.platform import force_cpu
+    force_cpu(1)
+
+    if args.engine:
+        import os
+        spec = json.load(open(os.path.join(args.engine, "spec.json")))
+        import numpy as np
+        from tpulab.engine.model import IOSpec, Model
+        model = Model(spec["name"], lambda p, x: x, None,
+                      [IOSpec(n, tuple(s), np.dtype(d))
+                       for n, s, d in spec["inputs"]],
+                      [IOSpec(n, tuple(s), np.dtype(d))
+                       for n, s, d in spec["outputs"]],
+                      spec["max_batch_size"], spec["batch_buckets"])
+        model.weights_size_in_bytes = lambda: 0
+    elif args.model:
+        from tpulab.models import build_model
+        model = build_model(args.model, max_batch_size=args.max_batch)
+    else:
+        ap.error("--model or --engine required")
+    json.dump(model_config(model, args.instances), sys.stdout, indent=2)
+    print()
+
+
+if __name__ == "__main__":
+    main()

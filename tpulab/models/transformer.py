@@ -106,3 +106,110 @@ def make_transformer(vocab: int = 32000, d_model: int = 512, n_heads: int = 8,
         outputs=[IOSpec("logits", (seq_len, vocab), np.float32)],
         max_batch_size=max_batch_size,
     )
+
+
+# ---------------------------------------------------------------------------
+# KV-cache decode (autoregressive serving)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(batch: int, max_len: int, n_layers: int, n_heads: int,
+                  head_dim: int, dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """Preallocated per-layer K/V rings (B, T_max, H, Dh)."""
+    shape = (batch, max_len, n_heads, head_dim)
+    return {f"layer{i}": {"k": jnp.zeros(shape, dtype),
+                          "v": jnp.zeros(shape, dtype)}
+            for i in range(n_layers)}
+
+
+def transformer_decode_step(params: Dict[str, Any], cache: Dict[str, Any],
+                            tokens: jnp.ndarray, pos: jnp.ndarray,
+                            n_heads: int = 8, n_layers: int = 6,
+                            compute_dtype=jnp.bfloat16):
+    """One decode step: tokens (B,) int32 at position ``pos`` (scalar int32).
+
+    Returns (logits (B, vocab) f32, updated cache).  Attention runs against
+    cache[: pos+1] via position masking — static shapes, scan/jit friendly
+    (no data-dependent Python control flow).
+    """
+    emb = params["embed"].astype(compute_dtype)
+    x = emb[tokens][:, None, :]                     # (B, 1, D)
+    b, _, d_model = x.shape
+    head_dim = d_model // n_heads
+    max_len = next(iter(cache.values()))["k"].shape[1]
+    new_cache = {}
+    for i in range(n_layers):
+        p = params[f"layer{i}"]
+        h = _rmsnorm(x, p["ln1"]["scale"])
+        qkv = h @ p["wqkv"].astype(compute_dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, 1, n_heads, head_dim)
+        k = k.reshape(b, 1, n_heads, head_dim)
+        v = v.reshape(b, 1, n_heads, head_dim)
+        ck = jax.lax.dynamic_update_slice(
+            cache[f"layer{i}"]["k"], k.astype(cache[f"layer{i}"]["k"].dtype),
+            (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cache[f"layer{i}"]["v"], v.astype(cache[f"layer{i}"]["v"].dtype),
+            (0, pos, 0, 0))
+        new_cache[f"layer{i}"] = {"k": ck, "v": cv}
+        # attend against positions <= pos (masked full-ring attention:
+        # static shapes; masked lanes cost FLOPs but keep XLA happy)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            ck.astype(jnp.float32)) / np.sqrt(head_dim)
+        k_pos = jnp.arange(max_len)
+        scores = jnp.where(k_pos[None, None, None, :] <= pos, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(compute_dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                          cv.astype(compute_dtype)).reshape(b, 1, d_model)
+        x = x + attn @ p["wo"].astype(compute_dtype)
+        h2 = _rmsnorm(x, p["ln2"]["scale"])
+        ff = jax.nn.gelu(h2 @ p["w1"].astype(compute_dtype))
+        x = x + ff @ p["w2"].astype(compute_dtype)
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    logits = (x[:, 0].astype(jnp.float32)
+              @ params["embed"].T.astype(jnp.float32))
+    return logits, new_cache
+
+
+def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
+                     max_len: int, compute_dtype=jnp.bfloat16):
+    """Jitted greedy generation: (prompt (B, T_p), steps) -> (B, steps).
+
+    Prefill replays the prompt through scanned decode steps to warm the
+    cache (a fused batched-prefill that writes the cache directly is the
+    next optimization); decode is a lax.scan of cached steps —
+    compiler-friendly: no growing shapes, no recompiles per step.
+    """
+
+    def generate(prompt: jnp.ndarray, steps: int):
+        b, t_p = prompt.shape
+        head_dim = params["layer0"]["wqkv"].shape[0] // n_heads
+        cache = init_kv_cache(b, max_len, n_layers, n_heads, head_dim,
+                              compute_dtype)
+        # prefill: run the full forward for logits, then replay the prompt
+        # through decode steps to warm the cache (simple, correct; a fused
+        # prefill that writes the cache directly is the next optimization)
+        def prefill_body(carry, i):
+            cache, _ = carry
+            logits, cache = transformer_decode_step(
+                params, cache, prompt[:, i], i, n_heads, n_layers,
+                compute_dtype)
+            return (cache, logits), None
+
+        (cache, logits), _ = jax.lax.scan(
+            prefill_body, (cache, jnp.zeros((b, params["embed"].shape[0]))),
+            jnp.arange(t_p))
+
+        def decode_body(carry, i):
+            cache, tok = carry
+            logits, cache = transformer_decode_step(
+                params, cache, tok, t_p + i, n_heads, n_layers, compute_dtype)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, nxt), nxt
+
+        first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        (_, _), toks = jax.lax.scan(decode_body, (cache, first),
+                                    jnp.arange(steps - 1))
+        return jnp.concatenate([first[:, None], toks.T], axis=1)
+
+    return jax.jit(generate, static_argnums=1)

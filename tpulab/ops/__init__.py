@@ -1,0 +1,14 @@
+"""tpulab.ops — Pallas TPU kernels for the hot ops.
+
+XLA fuses most of the model graph; these kernels cover the ops where manual
+VMEM scheduling wins (the role .cu kernels would play in a CUDA framework —
+the reference has none because TensorRT owns its kernels; a TPU-native
+framework owns its hot ops):
+
+- :mod:`flash_attention` — blockwise-softmax attention, O(T) memory,
+  MXU-shaped 128x128 tiles (drop-in ``attention_fn`` for the transformer)
+"""
+
+from tpulab.ops.flash_attention import flash_attention, make_flash_attention_fn
+
+__all__ = ["flash_attention", "make_flash_attention_fn"]
