@@ -72,3 +72,84 @@ def test_remote_binding_introspection(serving):
     runner = remote.infer_runner("mnist")
     assert runner.input_bindings()["Input3"][0] == (28, 28, 1)
     assert runner.output_bindings()["Plus214_Output_0"][1] == np.dtype(np.float32)
+
+
+def test_stream_infer_pipelined(serving):
+    """Bidirectional StreamInfer: N requests down one stream, correlated
+    responses (reference TRTIS StreamInfer / streaming lifecycle)."""
+    from tpulab.rpc.infer_service import StreamInferClient
+    mgr, remote = serving
+    client = StreamInferClient(remote, "mnist")
+    try:
+        x = np.random.default_rng(5).standard_normal((1, 28, 28, 1)).astype(np.float32)
+        futs = [client.submit(Input3=x) for _ in range(8)]
+        outs = [f.result(timeout=60) for f in futs]
+        assert all(o["Plus214_Output_0"].shape == (1, 10) for o in outs)
+        # parity with unary path
+        unary = remote.infer_runner("mnist").infer(Input3=x).result(timeout=60)
+        np.testing.assert_allclose(outs[0]["Plus214_Output_0"],
+                                   unary["Plus214_Output_0"], rtol=1e-5)
+    finally:
+        client.close()
+
+
+def test_stream_infer_bad_request_streams_error(serving):
+    from tpulab.rpc.infer_service import StreamInferClient
+    _mgr, remote = serving
+    client = StreamInferClient(remote, "mnist")
+    try:
+        bad = np.zeros((1, 28, 28, 1), np.float64)
+        with pytest.raises(RuntimeError):
+            client.submit(Input3=bad).result(timeout=60)
+        good = np.zeros((1, 28, 28, 1), np.float32)  # stream still healthy
+        assert client.submit(Input3=good).result(timeout=60)[
+            "Plus214_Output_0"].shape == (1, 10)
+    finally:
+        client.close()
+
+
+def test_stream_infer_under_fiber_executor():
+    """StreamInfer on the aio server: the async drain must not stall the
+    loop (review finding) — concurrent Health calls stay live mid-stream."""
+    from tpulab.rpc.executor import FiberExecutor
+    from tpulab.rpc.infer_service import (RemoteInferenceManager,
+                                          StreamInferClient)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=2)
+    mgr.register_model("mnist", make_mnist(max_batch_size=2))
+    mgr.update_resources()
+    mgr.serve(port=0, executor=FiberExecutor())
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    client = StreamInferClient(remote, "mnist")
+    try:
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        futs = [client.submit(Input3=x) for _ in range(6)]
+        # unary RPCs interleave with the open stream
+        assert "mnist" in remote.get_models()
+        outs = [f.result(timeout=60) for f in futs]
+        assert all(o["Plus214_Output_0"].shape == (1, 10) for o in outs)
+        client.close()
+    finally:
+        remote.close()
+        mgr.shutdown()
+
+
+def test_stream_infer_dead_stream_fails_pending():
+    """Killing the server fails outstanding stream futures promptly."""
+    from tpulab.rpc.infer_service import (RemoteInferenceManager,
+                                          StreamInferClient)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0)
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    client = StreamInferClient(remote, "mnist")
+    try:
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        client.submit(Input3=x).result(timeout=60)  # stream established
+        mgr.server.shutdown(grace_s=0.1)            # kill the server
+        fut = client.submit(Input3=x)               # rides the dead stream
+        with pytest.raises(Exception):
+            fut.result(timeout=30)  # fails promptly, not via caller timeout
+    finally:
+        remote.close()
+        mgr.shutdown()

@@ -16,8 +16,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from tpulab.core.resources import Resources
-from tpulab.rpc.client import ClientExecutor, ClientUnary
-from tpulab.rpc.context import Context
+from tpulab.rpc.client import ClientExecutor, ClientStreaming, ClientUnary
+from tpulab.rpc.context import Context, StreamingContext
 from tpulab.rpc.executor import Executor
 from tpulab.rpc.protos import inference_pb2 as pb
 from tpulab.rpc.server import AsyncService, Server
@@ -165,6 +165,85 @@ class HealthContext(Context):
         return pb.HealthResponse(live=True, ready=res.manager is not None)
 
 
+class StreamInferContext(StreamingContext):
+    """Bidirectional pipelined inference (reference TRTIS StreamInfer /
+    nvrpc streaming contexts): each incoming InferRequest dispatches
+    immediately; responses stream back as they complete, correlated by
+    ``correlation_id`` (responses may arrive out of order — that is the
+    point: the stream stays full while the device pipeline works).
+
+    Each worker writes its response *before* its future resolves, so the
+    end-of-stream drain cannot close the stream ahead of a tail response;
+    completed entries prune themselves (long-lived streams stay O(inflight)).
+    """
+
+    DRAIN_TIMEOUT_S = 300.0
+
+    def __init__(self, resources=None):
+        super().__init__(resources)
+        import threading
+        self._lock = threading.Lock()
+        self._inflight: Dict[int, object] = {}  # seq -> worker future
+        self._seq = 0
+
+    def on_request(self, request: pb.InferRequest) -> None:
+        res = self.get_resources(InferResources)
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+
+        def run():
+            try:
+                resp = InferContext(res).execute_rpc(request)
+            except BaseException as e:  # noqa: BLE001 - always respond
+                resp = pb.InferResponse(
+                    model_name=request.model_name,
+                    correlation_id=request.correlation_id,
+                    status=pb.RequestStatus(code=pb.INTERNAL, message=str(e)))
+            # response enqueued BEFORE the future resolves: the drain can
+            # never overtake it; then prune this entry
+            self.write(resp)
+            with self._lock:
+                self._inflight.pop(seq, None)
+
+        fut = res.manager.workers("pre").enqueue(run)
+        with self._lock:
+            if not fut.done():  # skip if the worker already ran and pruned
+                self._inflight[seq] = fut
+
+    def _pending(self):
+        with self._lock:
+            return list(self._inflight.values())
+
+    def on_requests_finished(self):
+        """Drain in-flight work; blocking on thread executors, awaitable on
+        the event-loop (Fiber) executor so the loop never stalls."""
+        try:
+            import asyncio
+            asyncio.get_running_loop()
+        except RuntimeError:
+            self._drain_sync()
+            return None
+        return self._drain_async()
+
+    def _drain_sync(self) -> None:
+        import time as _time
+        deadline = _time.monotonic() + self.DRAIN_TIMEOUT_S
+        for f in self._pending():
+            try:
+                f.result(timeout=max(0.0, deadline - _time.monotonic()))
+            except Exception:
+                log.warning("stream drain: in-flight request did not "
+                            "complete before the drain deadline")
+
+    async def _drain_async(self) -> None:
+        import asyncio
+        import time as _time
+        deadline = _time.monotonic() + self.DRAIN_TIMEOUT_S
+        while self._pending() and _time.monotonic() < deadline:
+            await asyncio.sleep(0.005)
+
+
 def build_infer_service(manager, address: str = "0.0.0.0:0",
                         executor: Optional[Executor] = None,
                         batching: bool = False,
@@ -190,6 +269,9 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     service.register_rpc("Health", HealthContext,
                          pb.HealthRequest.FromString,
                          pb.HealthResponse.SerializeToString)
+    service.register_rpc("StreamInfer", StreamInferContext,
+                         pb.InferRequest.FromString,
+                         pb.InferResponse.SerializeToString)
     server.register_async_service(service)
     return server
 
@@ -221,6 +303,68 @@ class RemoteInferenceManager:
 
     def close(self) -> None:
         self._executor.close()
+
+
+class StreamInferClient:
+    """Pipelined streaming client (reference client_streaming v3 usage):
+    ``submit(**arrays) -> Future`` over one bidi stream; responses correlate
+    by id."""
+
+    def __init__(self, manager: "RemoteInferenceManager", model_name: str):
+        import threading
+        self.model_name = model_name
+        self._lock = threading.Lock()
+        self._pending: Dict[int, object] = {}
+        self._next_id = 1
+        self._stream = ClientStreaming(
+            manager._executor, f"/{SERVICE_NAME}/StreamInfer",
+            self._on_response,
+            pb.InferRequest.SerializeToString, pb.InferResponse.FromString)
+        # a dead stream must fail every outstanding future, not strand them
+        self._stream.done().add_done_callback(self._on_stream_done)
+
+    def _on_stream_done(self, done_fut) -> None:
+        exc = done_fut.exception()
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(exc or RuntimeError(
+                    "stream closed with responses outstanding"))
+
+    def _on_response(self, resp: pb.InferResponse) -> None:
+        with self._lock:
+            fut = self._pending.pop(resp.correlation_id, None)
+        if fut is None:
+            return
+        if resp.status.code != pb.SUCCESS:
+            fut.set_exception(RuntimeError(
+                f"stream inference failed: {resp.status.message}"))
+        else:
+            fut.set_result({t.name: proto_to_tensor(t) for t in resp.outputs})
+
+    def submit(self, **arrays: np.ndarray):
+        from concurrent.futures import Future
+        if not arrays:
+            raise ValueError("no input arrays")
+        fut: Future = Future()
+        with self._lock:
+            cid = self._next_id
+            self._next_id += 1
+            self._pending[cid] = fut
+        req = pb.InferRequest(model_name=self.model_name,
+                              batch_size=next(iter(arrays.values())).shape[0],
+                              correlation_id=cid)
+        for name, arr in arrays.items():
+            req.inputs.append(tensor_to_proto(name, arr))
+        self._stream.write(req)
+        return fut
+
+    def close(self) -> None:
+        """Half-close and wait for the server's drain; stream errors
+        propagate (pending futures were already failed by the callback)."""
+        self._stream.writes_done()
+        self._stream.done().result(timeout=330)
 
 
 class InferRemoteRunner:
