@@ -195,3 +195,133 @@ def test_torch_resnet_import_roundtrip():
     x = {"input": np.zeros((1, 224, 224, 3), np.float32)}
     out = model.apply_fn(model.params, x)["logits"]
     assert np.isfinite(np.asarray(out)).all()
+
+
+# --------------------------------------------------------------- generation --
+def test_generation_engine_sessions():
+    import jax.numpy as jnp
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.transformer import init_transformer_params
+
+    params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                     n_layers=2, d_ff=64)
+    eng = GenerationEngine(params, n_heads=2, n_layers=2, max_len=48,
+                           max_sessions=2, compute_dtype=jnp.float32)
+    prompt = np.random.default_rng(0).integers(0, 64, (8,), np.int32)
+
+    # streaming session matches one-shot jitted generate
+    with eng.start_session() as s:
+        s.prefill(prompt)
+        streamed = list(s.stream(6))
+    batch = eng.generate(prompt[None, :], 6)[0]
+    np.testing.assert_array_equal(np.asarray(streamed), batch)
+
+    # slots recycle and start clean
+    assert eng.available_sessions == 2
+    with eng.start_session() as s2:
+        s2.prefill(prompt)
+        again = list(s2.stream(6))
+    np.testing.assert_array_equal(np.asarray(again), batch)
+
+
+def test_generation_session_backpressure_and_limits():
+    import jax.numpy as jnp
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.transformer import init_transformer_params
+
+    params = init_transformer_params(vocab=32, d_model=32, n_heads=2,
+                                     n_layers=1, d_ff=64)
+    eng = GenerationEngine(params, n_heads=2, n_layers=1, max_len=8,
+                           max_sessions=1, compute_dtype=jnp.float32)
+    s = eng.start_session()
+    with pytest.raises(TimeoutError):
+        eng.start_session(timeout=0.05)   # pool exhausted — backpressure
+    with pytest.raises(ValueError, match="max_len"):
+        s.prefill(np.zeros(9, np.int32))  # over capacity
+    s.prefill(np.zeros(4, np.int32))
+    s.close()
+    s.close()  # idempotent
+    s2 = eng.start_session(timeout=1)
+    s2.close()
+
+
+def test_generate_rpc_streams_tokens():
+    """End-to-end: Generate RPC streams tokens matching local generation."""
+    import jax.numpy as jnp
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.transformer import init_transformer_params
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+
+    params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                     n_layers=2, d_ff=64)
+    eng = GenerationEngine(params, n_heads=2, n_layers=2, max_len=32,
+                           max_sessions=2, compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))  # any model
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": eng})
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        prompt = np.random.default_rng(0).integers(0, 64, (6,), np.int32)
+        client = GenerateStreamClient(remote, "lm")
+        streamed = list(client.generate(prompt, 5))
+        local = eng.generate(prompt[None, :], 5)[0]
+        np.testing.assert_array_equal(np.asarray(streamed), local)
+        # unknown generation model -> clean error
+        with pytest.raises(RuntimeError, match="no generation engine"):
+            list(GenerateStreamClient(remote, "nope").generate(prompt, 2))
+    finally:
+        remote.close()
+        mgr.shutdown()
+
+
+def test_generate_rpc_under_fiber_executor():
+    """Generation under the aio executor must not stall other RPCs."""
+    import jax.numpy as jnp
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.transformer import init_transformer_params
+    from tpulab.rpc.executor import FiberExecutor
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+
+    params = init_transformer_params(vocab=32, d_model=32, n_heads=2,
+                                     n_layers=1, d_ff=64)
+    eng = GenerationEngine(params, n_heads=2, n_layers=1, max_len=32,
+                           max_sessions=1, compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, executor=FiberExecutor(), generation_engines={"lm": eng})
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        import threading
+        prompt = np.zeros(4, np.int32)
+        toks = []
+        t = threading.Thread(target=lambda: toks.extend(
+            GenerateStreamClient(remote, "lm").generate(prompt, 10)))
+        t.start()
+        # unary traffic stays live while generation streams
+        assert "mnist" in remote.get_models()
+        t.join(timeout=120)
+        assert len(toks) == 10
+    finally:
+        remote.close()
+        mgr.shutdown()
+
+
+def test_generation_session_use_after_close():
+    import jax.numpy as jnp
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.transformer import init_transformer_params
+    params = init_transformer_params(vocab=32, d_model=32, n_heads=2,
+                                     n_layers=1, d_ff=64)
+    eng = GenerationEngine(params, n_heads=2, n_layers=1, max_len=8,
+                           max_sessions=1, compute_dtype=jnp.float32)
+    s = eng.start_session()
+    s.prefill(np.zeros(2, np.int32))
+    s.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        s.step()
+    with pytest.raises(RuntimeError, match="closed"):
+        s.prefill(np.zeros(1, np.int32))

@@ -1,0 +1,151 @@
+"""Generation engine: session-pooled autoregressive serving.
+
+Beyond the reference's scope (trtlab predates LLM serving) but squarely in
+this framework's long-context mandate: KV caches are the activation-scratch
+of generative serving, so they get the same treatment the reference gives
+execution contexts — preallocated, pooled, leased per request with blocking
+backpressure (SURVEY §2.5 token-pool semantics).
+
+- :class:`GenerationEngine` — owns device params, the jitted decode step and
+  batch ``generate`` program, and a pool of cache slots.
+- :class:`GenerationSession` — one leased cache slot: ``prefill(tokens)``
+  then ``step()`` per token (streaming), or ``generate(prompt, n)`` one-shot.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from tpulab.core.pool import Pool, PoolItem
+
+
+class GenerationEngine:
+    """Pooled generation over tpulab's transformer family."""
+
+    def __init__(self, params: Any, n_heads: int, n_layers: int,
+                 max_len: int = 1024, max_sessions: int = 2,
+                 compute_dtype=None, device=None):
+        import jax
+        import jax.numpy as jnp
+        from tpulab.models.transformer import (init_kv_cache,
+                                               make_generate_fn,
+                                               transformer_decode_step)
+        from tpulab.tpu import platform as plat
+
+        self.device = device if device is not None else plat.local_device(0)
+        compute_dtype = compute_dtype or jnp.bfloat16
+        self.compute_dtype = compute_dtype
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.max_len = max_len
+        self.params = jax.device_put(params, self.device)
+        d_model = params["layer0"]["wqkv"].shape[0]
+        self.head_dim = d_model // n_heads
+
+        self._decode = jax.jit(partial(
+            transformer_decode_step, n_heads=n_heads, n_layers=n_layers,
+            compute_dtype=compute_dtype))
+        self._generate = make_generate_fn(self.params, n_heads, n_layers,
+                                          max_len, compute_dtype)
+        # cache slots: the generation analog of execution-context pooling
+        self._init_cache = partial(init_kv_cache, 1, max_len, n_layers,
+                                   n_heads, self.head_dim, compute_dtype)
+        self._sessions: Pool = Pool(
+            (self._init_cache() for _ in range(max_sessions)))
+
+    # -- one-shot -----------------------------------------------------------
+    def generate(self, prompt: np.ndarray, steps: int) -> np.ndarray:
+        """Batch greedy generation (jitted prefill+decode scan)."""
+        import jax.numpy as jnp
+        return np.asarray(self._generate(jnp.asarray(prompt), steps))
+
+    # -- streaming sessions --------------------------------------------------
+    def start_session(self, timeout: Optional[float] = None) -> "GenerationSession":
+        """Lease a cache slot; blocks when all sessions are busy."""
+        item = self._sessions.pop(timeout)
+        return GenerationSession(self, item)
+
+    @property
+    def available_sessions(self) -> int:
+        return self._sessions.available
+
+
+class GenerationSession:
+    """One leased KV-cache slot (close/GC returns it to the pool)."""
+
+    def __init__(self, engine: GenerationEngine, item: PoolItem):
+        self._engine = engine
+        self._item = item
+        self._cache = item.get()
+        self._pos = 0
+        self._last_logits = None
+        self._closed = False
+
+    @property
+    def position(self) -> int:
+        return self._pos
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise RuntimeError("generation session is closed")
+
+    def prefill(self, tokens: np.ndarray) -> None:
+        """Feed prompt tokens ((T,) int32) through decode steps."""
+        import jax.numpy as jnp
+        self._check_open()
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if self._pos + len(tokens) > self._engine.max_len:
+            raise ValueError(f"session length {self._pos + len(tokens)} "
+                             f"exceeds max_len {self._engine.max_len}")
+        for t in tokens:
+            self._last_logits, self._cache = self._engine._decode(
+                self._engine.params, self._cache,
+                jnp.asarray([t], jnp.int32), jnp.int32(self._pos))
+            self._pos += 1
+
+    def step(self, token: Optional[int] = None) -> int:
+        """Advance one token; ``token=None`` feeds back the greedy argmax
+        of the last logits (generation), else feeds the given token
+        (teacher-forced scoring).  Returns the next predicted token."""
+        import jax.numpy as jnp
+        self._check_open()
+        if self._last_logits is None and token is None:
+            raise RuntimeError("prefill before generating")
+        if token is None:
+            token = int(np.asarray(self._last_logits).argmax(-1)[0])
+        if self._pos >= self._engine.max_len:
+            raise ValueError(f"session exceeded max_len {self._engine.max_len}")
+        self._last_logits, self._cache = self._engine._decode(
+            self._engine.params, self._cache,
+            jnp.asarray([token], jnp.int32), jnp.int32(self._pos))
+        self._pos += 1
+        return int(np.asarray(self._last_logits).argmax(-1)[0])
+
+    def stream(self, steps: int) -> Iterator[int]:
+        """Yield ``steps`` greedily generated tokens."""
+        tok = None
+        for _ in range(steps):
+            tok = self.step(tok)
+            yield tok
+
+    def close(self) -> None:
+        """Return the cache slot.  Decode is functional (each step yields a
+        fresh cache tree), so the pooled slot keeps its pristine zero cache
+        and the next lease starts clean; the session's working caches are
+        garbage once released.  (Buffer donation per step is the next
+        optimization — it requires copy-on-lease so the pooled buffers are
+        never donated away.)"""
+        if not self._closed:
+            self._closed = True
+            self._cache = None
+            self._item.release()
+
+    def __enter__(self) -> "GenerationSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

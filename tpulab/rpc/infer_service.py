@@ -45,10 +45,12 @@ class InferResources(Resources):
     (reference Resources bundle handed to contexts)."""
 
     def __init__(self, manager, batching: bool = False,
-                 batch_window_s: float = 0.002, metrics=None):
+                 batch_window_s: float = 0.002, metrics=None,
+                 generation_engines: Optional[Dict[str, object]] = None):
         self.manager = manager
         self.metrics = metrics
         self.batching = batching
+        self.generation_engines = generation_engines or {}
         self._batch_window_s = batch_window_s
         self._batched: Dict[str, object] = {}
         self._lock = __import__("threading").Lock()
@@ -191,29 +193,32 @@ class StreamInferContext(StreamingContext):
         with self._lock:
             seq = self._seq
             self._seq += 1
+            # registered BEFORE the worker starts: run()'s prune always
+            # finds the entry, so nothing can leak (drain polls emptiness)
+            self._inflight[seq] = True
 
         def run():
             try:
-                resp = InferContext(res).execute_rpc(request)
-            except BaseException as e:  # noqa: BLE001 - always respond
-                resp = pb.InferResponse(
-                    model_name=request.model_name,
-                    correlation_id=request.correlation_id,
-                    status=pb.RequestStatus(code=pb.INTERNAL, message=str(e)))
-            # response enqueued BEFORE the future resolves: the drain can
-            # never overtake it; then prune this entry
-            self.write(resp)
-            with self._lock:
-                self._inflight.pop(seq, None)
+                try:
+                    resp = InferContext(res).execute_rpc(request)
+                except BaseException as e:  # noqa: BLE001 - always respond
+                    resp = pb.InferResponse(
+                        model_name=request.model_name,
+                        correlation_id=request.correlation_id,
+                        status=pb.RequestStatus(code=pb.INTERNAL,
+                                                message=str(e)))
+                # response enqueued BEFORE this entry prunes: the drain can
+                # never close the stream ahead of it
+                self.write(resp)
+            finally:
+                with self._lock:
+                    self._inflight.pop(seq, None)
 
-        fut = res.manager.workers("pre").enqueue(run)
-        with self._lock:
-            if not fut.done():  # skip if the worker already ran and pruned
-                self._inflight[seq] = fut
+        res.manager.workers("pre").enqueue(run)
 
-    def _pending(self):
+    def _busy(self) -> bool:
         with self._lock:
-            return list(self._inflight.values())
+            return bool(self._inflight)
 
     def on_requests_finished(self):
         """Drain in-flight work; blocking on thread executors, awaitable on
@@ -229,18 +234,17 @@ class StreamInferContext(StreamingContext):
     def _drain_sync(self) -> None:
         import time as _time
         deadline = _time.monotonic() + self.DRAIN_TIMEOUT_S
-        for f in self._pending():
-            try:
-                f.result(timeout=max(0.0, deadline - _time.monotonic()))
-            except Exception:
-                log.warning("stream drain: in-flight request did not "
-                            "complete before the drain deadline")
+        while self._busy() and _time.monotonic() < deadline:
+            _time.sleep(0.005)
+        if self._busy():
+            log.warning("stream drain: in-flight requests did not complete "
+                        "before the drain deadline")
 
     async def _drain_async(self) -> None:
         import asyncio
         import time as _time
         deadline = _time.monotonic() + self.DRAIN_TIMEOUT_S
-        while self._pending() and _time.monotonic() < deadline:
+        while self._busy() and _time.monotonic() < deadline:
             await asyncio.sleep(0.005)
 
 
@@ -248,7 +252,9 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                         executor: Optional[Executor] = None,
                         batching: bool = False,
                         batch_window_s: float = 0.002,
-                        metrics=None) -> Server:
+                        metrics=None,
+                        generation_engines: Optional[Dict[str, object]] = None
+                        ) -> Server:
     """Wire the inference service onto a Server
     (reference BasicInferService ctor infer.cc:644-678).
 
@@ -256,7 +262,8 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     Infer calls aggregate into one device batch per model (examples/03's
     middleman capability, in-process)."""
     resources = InferResources(manager, batching=batching,
-                               batch_window_s=batch_window_s, metrics=metrics)
+                               batch_window_s=batch_window_s, metrics=metrics,
+                               generation_engines=generation_engines)
     server = Server(address, executor or Executor(n_threads=4))
     server._infer_resources = resources  # for shutdown
     service = AsyncService(SERVICE_NAME, resources)
@@ -272,8 +279,86 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     service.register_rpc("StreamInfer", StreamInferContext,
                          pb.InferRequest.FromString,
                          pb.InferResponse.SerializeToString)
+    service.register_rpc("Generate", GenerateContext,
+                         pb.GenerateRequest.FromString,
+                         pb.GenerateResponse.SerializeToString)
     server.register_async_service(service)
     return server
+
+
+class GenerateContext(StreamingContext):
+    """Token-streaming generation (bidi: one GenerateRequest in, one
+    GenerateResponse per generated token out).  Leases a pooled KV-cache
+    session per request — blocking lease = natural generation backpressure."""
+
+    def on_request(self, request: pb.GenerateRequest):
+        """Generation is long-running: under the aio (Fiber) executor the
+        body runs on a worker thread and an awaitable is returned, so the
+        event loop never stalls on decode or on session-pool backpressure."""
+        try:
+            import asyncio
+            asyncio.get_running_loop()
+        except RuntimeError:
+            self._run(request)      # thread executor: blocking is fine
+            return None
+        res = self.get_resources(InferResources)
+        fut = res.manager.workers("pre").enqueue(self._run, request)
+        import asyncio
+        return asyncio.wrap_future(fut)
+
+    def _run(self, request: pb.GenerateRequest) -> None:
+        res = self.get_resources(InferResources)
+        engine = res.generation_engines.get(request.model_name)
+        if engine is None:
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.UNKNOWN_MODEL,
+                message=f"no generation engine for {request.model_name!r}")))
+            return
+        try:
+            with engine.start_session() as session:
+                session.prefill(np.asarray(request.prompt, np.int32))
+                for i, tok in enumerate(session.stream(request.steps)):
+                    self.write(pb.GenerateResponse(token=tok, index=i))
+            self.write(pb.GenerateResponse(
+                final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
+        except Exception as e:  # noqa: BLE001
+            log.exception("generation failed")
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INTERNAL, message=str(e))))
+
+
+class GenerateStreamClient:
+    """Client: ``generate(prompt, steps)`` yields tokens as they stream."""
+
+    def __init__(self, manager: "RemoteInferenceManager", model_name: str):
+        self._manager = manager
+        self.model_name = model_name
+
+    def generate(self, prompt, steps: int, timeout: float = 300.0):
+        import queue as _q
+        out: "_q.Queue" = _q.Queue()
+        stream = ClientStreaming(
+            self._manager._executor, f"/{SERVICE_NAME}/Generate", out.put,
+            pb.GenerateRequest.SerializeToString, pb.GenerateResponse.FromString)
+        # a dead stream must wake the consumer promptly, not via timeout
+        _STREAM_DEAD = object()
+        stream.done().add_done_callback(lambda _f: out.put(_STREAM_DEAD))
+        stream.write(pb.GenerateRequest(
+            model_name=self.model_name,
+            prompt=list(np.asarray(prompt, np.int32)), steps=steps))
+        stream.writes_done()
+        while True:
+            resp = out.get(timeout=timeout)
+            if resp is _STREAM_DEAD:
+                exc = stream.done().exception()
+                raise (exc if exc is not None else RuntimeError(
+                    "generation stream closed before completion"))
+            if resp.final:
+                if resp.status.code not in (pb.SUCCESS, 0):
+                    raise RuntimeError(
+                        f"generation failed: {resp.status.message}")
+                return
+            yield resp.token
 
 
 # -- remote client ------------------------------------------------------------
