@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Host-runtime microbenchmarks (reference core/benchmarks: bench_pool.cc
+pool pop cost, bench_batcher.cc batcher + full dispatcher engine,
+bench_memory_stack.cc transactional vs malloc).
+
+    python benchmarks/bench_host.py
+"""
+
+import time
+
+import numpy as np
+
+
+def timer(fn, n, warmup=1000):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n * 1e9  # ns/op
+
+
+def bench_pool():
+    from tpulab.core import Pool
+    pool = Pool([1, 2, 3, 4])
+
+    def op():
+        item = pool.pop()
+        item.release()
+    print(f"{'Pool pop/release':40s} {timer(op, 20000):10.0f} ns/op")
+
+
+def bench_native_pool():
+    from tpulab import native
+    if not native.available():
+        print(f"{'native TokenPool (not built)':40s} {'-':>10s}")
+        return
+    pool = native.NativeTokenPool()
+    pool.push(1)
+
+    def op():
+        pool.push(pool.pop())
+    print(f"{'native TokenPool pop/push':40s} {timer(op, 100000):10.0f} ns/op")
+    pool.close()
+
+
+def bench_transactional():
+    import tpulab.memory as tm
+    tx = tm.TransactionalAllocator(
+        tm.FixedSizeBlockAllocator(tm.MallocAllocator(), 1 << 20))
+
+    def op():
+        a = tx.allocate_node(256)
+        tx.deallocate_node(a)
+    print(f"{'py transactional alloc/free 256B':40s} {timer(op, 50000):10.0f} ns/op")
+
+
+def bench_native_transactional():
+    from tpulab import native
+    if not native.available():
+        print(f"{'native transactional (not built)':40s} {'-':>10s}")
+        return
+    tx = native.NativeTransactionalAllocator(block_size=1 << 20)
+
+    def op():
+        a = tx.allocate_node(256)
+        tx.deallocate_node(a)
+    print(f"{'native transactional alloc/free 256B':40s} {timer(op, 100000):10.0f} ns/op")
+    tx.close()
+
+
+def bench_batcher():
+    from tpulab.core import StandardBatcher
+    b = StandardBatcher(max_batch_size=8)
+
+    def op():
+        b.enqueue(1)
+        batch = b.update()
+        if batch:
+            batch.complete(None)
+    print(f"{'StandardBatcher enqueue+update':40s} {timer(op, 50000):10.0f} ns/op")
+
+
+def bench_dispatcher_engine():
+    """Full dispatcher engine throughput (reference bench_batcher.cc:81-127)."""
+    from tpulab.core import Dispatcher
+    done = [0]
+
+    def execute(items, complete):
+        done[0] += len(items)
+        complete(None)
+
+    with Dispatcher(max_batch_size=64, window_s=0.001,
+                    execute_fn=execute, n_workers=2) as d:
+        n = 50000
+        t0 = time.perf_counter()
+        futs = [d.enqueue(i) for i in range(n)]
+        for f in futs:
+            f.result(timeout=30)
+        dt = time.perf_counter() - t0
+    print(f"{'Dispatcher engine (64-batch)':40s} {n / dt:10.0f} items/s")
+
+
+def bench_staging_carve():
+    from tpulab.engine.buffers import Buffers
+    from tpulab.models.mnist import make_mnist
+    m = make_mnist(max_batch_size=8)
+    buffers = Buffers(m.bindings_size_in_bytes() + (128 << 10))
+
+    def op():
+        b = buffers.create_bindings(m, 8)
+        b.release()
+        buffers.reset()
+    print(f"{'Bindings carve+reset (mnist b=8)':40s} {timer(op, 2000, 100):10.0f} ns/op")
+
+
+if __name__ == "__main__":
+    from tpulab.tpu.platform import force_cpu
+    force_cpu(1)  # host benchmarks must not depend on device availability
+    print(f"{'benchmark':40s} {'result':>10s}")
+    print("-" * 56)
+    bench_pool()
+    bench_native_pool()
+    bench_transactional()
+    bench_native_transactional()
+    bench_batcher()
+    bench_dispatcher_engine()
+    bench_staging_carve()

@@ -170,3 +170,14 @@ def test_generate_fn_greedy():
     # deterministic greedy
     out2 = gen(prompt, 8)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+# -------------------------------------------------------------- multihost ---
+def test_multihost_helpers_single_process():
+    from tpulab.parallel import multihost
+    multihost.initialize()  # no-op on single host
+    mesh = multihost.global_mesh(n_model=2)
+    assert mesh.shape == {"data": 4, "model": 2}
+    multihost.barrier(mesh)  # completes = all devices reached it
+    lo, hi = multihost.local_data_slice(32, mesh)
+    assert (lo, hi) == (0, 32)  # single process feeds everything

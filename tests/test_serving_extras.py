@@ -325,3 +325,56 @@ def test_generation_session_use_after_close():
         s.step()
     with pytest.raises(RuntimeError, match="closed"):
         s.prefill(np.zeros(1, np.int32))
+
+
+# ---------------------------------------------------------------- watchdog --
+def test_watchdog_healthy_and_wedge_detection():
+    from tpulab.utils.watchdog import DeviceWatchdog
+    events = []
+    wd = DeviceWatchdog(period_s=0.05, deadline_s=5.0,
+                        on_unhealthy=events.append).start()
+    try:
+        time.sleep(0.4)
+        assert wd.healthy and wd.seconds_since_ok is not None
+        # wedge simulation: canary that never completes
+        import threading
+        wd._canary = (lambda x: _Never(), wd._canary[1])
+        wd.deadline_s = 0.1
+        time.sleep(0.5)
+        assert not wd.healthy
+        assert "deadline" in wd.reason or "outstanding" in wd.reason
+        assert events  # hook fired
+    finally:
+        wd.stop()
+
+
+class _Never:
+    def block_until_ready(self):
+        time.sleep(60)
+
+
+def test_watchdog_wired_into_health_rpc():
+    """Unhealthy watchdog -> Health RPC reports not-ready (review finding)."""
+    from tpulab.rpc.client import ClientExecutor, ClientUnary
+    from tpulab.rpc.infer_service import SERVICE_NAME
+    from tpulab.rpc.protos import inference_pb2 as pb
+
+    class FakeWatchdog:
+        healthy = True
+
+    wd = FakeWatchdog()
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, watchdog=wd)
+    try:
+        with ClientExecutor(f"localhost:{mgr.server.bound_port}") as cx:
+            health = ClientUnary(cx, f"/{SERVICE_NAME}/Health",
+                                 pb.HealthRequest.SerializeToString,
+                                 pb.HealthResponse.FromString)
+            assert health.call(pb.HealthRequest(), timeout=30).ready
+            wd.healthy = False
+            resp = health.call(pb.HealthRequest(), timeout=30)
+            assert resp.live and not resp.ready
+    finally:
+        mgr.shutdown()

@@ -33,7 +33,8 @@ class InferenceManager(_EngineManager):
     def serve(self, port: int = 50051, wait: bool = False,
               executor=None, batching: bool = False,
               batch_window_s: float = 0.002,
-              metrics=None, generation_engines=None) -> "InferenceManager":
+              metrics=None, generation_engines=None,
+              watchdog=None) -> "InferenceManager":
         """Expose registered models over the TRTIS-style gRPC service
         (reference manager.serve() -> BasicInferService).  ``batching=True``
         enables server-side dynamic batching across concurrent callers;
@@ -44,7 +45,7 @@ class InferenceManager(_EngineManager):
         self._server = build_infer_service(
             self, f"0.0.0.0:{port}", executor=executor, batching=batching,
             batch_window_s=batch_window_s, metrics=metrics,
-            generation_engines=generation_engines)
+            generation_engines=generation_engines, watchdog=watchdog)
         if wait:
             self._server.run()
         else:

@@ -1,0 +1,96 @@
+"""Multi-host initialization + topology helpers.
+
+The reference's multi-node story is deployment-level (envoy/k8s + MPI
+barriers for coordinated benchmarking — SURVEY §2.9).  On TPU pods the
+in-process story is ``jax.distributed``: every host initializes against a
+coordinator, global meshes span hosts, and XLA routes collectives over
+ICI within a slice and DCN across slices.
+
+- :func:`initialize` — jax.distributed bootstrap (env-derived defaults on
+  Cloud TPU: coordinator/process counts come from the TPU metadata).
+- :func:`global_mesh` — mesh over *all* processes' devices with the DP axis
+  outermost (DCN-friendly) and model axes inner (ICI-resident).
+- :func:`barrier` — the MPI_Barrier analog used by coordinated benchmarks
+  (reference examples/00 infer.cc:39-44): a tiny psum across all devices.
+- :func:`local_data_slice` — which rows of a globally-sharded batch this
+  host feeds (process-local data loading).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bootstrap multi-host JAX.  No-ops on single-process setups; on Cloud
+    TPU pods all arguments auto-derive from the TPU environment."""
+    import jax
+    # must not touch jax.process_count()/devices() first: that would create
+    # the backends and make distributed.initialize() unusable
+    try:
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is not None:
+            return  # already initialized
+    except Exception:  # pragma: no cover - private-API drift tolerated
+        pass
+    explicit = coordinator_address is not None or num_processes is not None
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except (ValueError, RuntimeError):
+        if explicit:
+            # caller asked for a specific multi-host setup — a silent no-op
+            # here would quietly run the pod single-host
+            raise
+        # auto-detection path: single-host / already-created backends are
+        # normal (tests, laptops); multi-host envs auto-configure before
+        # any backend use
+
+
+def global_mesh(n_model: int = 1, extra_axes: Optional[Dict[str, int]] = None):
+    """Mesh over every device in the job: data (outermost, spans hosts /
+    DCN) x model (innermost, stays on-slice ICI) [+ extra inner axes]."""
+    import jax
+    from tpulab.parallel.mesh import make_mesh
+
+    devs = jax.devices()  # global across processes
+    inner = {"model": n_model, **(extra_axes or {})}
+    inner_total = 1
+    for v in inner.values():
+        inner_total *= v
+    if len(devs) % inner_total:
+        raise ValueError(f"{len(devs)} devices not divisible by inner axes "
+                         f"{inner}")
+    return make_mesh({"data": len(devs) // inner_total, **inner}, devs)
+
+
+def barrier(mesh=None) -> None:
+    """Cross-host barrier (reference MPI_Barrier benchmark coordination):
+    a psum over every device — returns when all hosts reached it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        mesh = global_mesh()
+    ones = jax.device_put(
+        jnp.ones((len(mesh.devices.flat),), jnp.int32),
+        NamedSharding(mesh, P(mesh.axis_names[0])))
+    total = jax.jit(lambda x: x.sum(),
+                    out_shardings=NamedSharding(mesh, P()))(ones)
+    assert int(total) == len(mesh.devices.flat)
+
+
+def local_data_slice(global_batch: int, mesh=None) -> Tuple[int, int]:
+    """[start, stop) rows of the global batch this process should feed
+    (data axis is outermost, so rows map contiguously to processes).
+    Remainder rows spread over the first processes — every row is owned."""
+    import jax
+    n = jax.process_count()
+    i = jax.process_index()
+    per, rem = divmod(global_batch, n)
+    start = i * per + min(i, rem)
+    return start, start + per + (1 if i < rem else 0)

@@ -46,14 +46,26 @@ class InferResources(Resources):
 
     def __init__(self, manager, batching: bool = False,
                  batch_window_s: float = 0.002, metrics=None,
-                 generation_engines: Optional[Dict[str, object]] = None):
+                 generation_engines: Optional[Dict[str, object]] = None,
+                 watchdog=None):
         self.manager = manager
         self.metrics = metrics
         self.batching = batching
         self.generation_engines = generation_engines or {}
+        self.watchdog = watchdog
         self._batch_window_s = batch_window_s
         self._batched: Dict[str, object] = {}
+        self._generate_workers = None  # dedicated pool, built on first use
         self._lock = __import__("threading").Lock()
+
+    def generate_workers(self):
+        """Generation gets its own workers: long decodes + session-pool
+        waits must not starve the shared 'pre' pool (StreamInfer/batching)."""
+        from tpulab.core.thread_pool import ThreadPool
+        with self._lock:
+            if self._generate_workers is None:
+                self._generate_workers = ThreadPool(4, name="generate")
+            return self._generate_workers
 
     def runner(self, model_name: str):
         """Per-model runner; the batched variant aggregates concurrent
@@ -72,6 +84,9 @@ class InferResources(Resources):
             for r in self._batched.values():
                 r.shutdown()
             self._batched.clear()
+            if self._generate_workers is not None:
+                self._generate_workers.shutdown(wait=False)
+                self._generate_workers = None
 
 
 class StatusContext(Context):
@@ -164,7 +179,11 @@ class InferContext(Context):
 class HealthContext(Context):
     def execute_rpc(self, request: pb.HealthRequest) -> pb.HealthResponse:
         res = self.get_resources(InferResources)
-        return pb.HealthResponse(live=True, ready=res.manager is not None)
+        ready = res.manager is not None
+        if res.watchdog is not None:
+            # wedged-device detection: k8s/envoy rotate the replica out
+            ready = ready and res.watchdog.healthy
+        return pb.HealthResponse(live=True, ready=ready)
 
 
 class StreamInferContext(StreamingContext):
@@ -214,7 +233,12 @@ class StreamInferContext(StreamingContext):
                 with self._lock:
                     self._inflight.pop(seq, None)
 
-        res.manager.workers("pre").enqueue(run)
+        try:
+            res.manager.workers("pre").enqueue(run)
+        except BaseException:  # enqueue failed: prune or the drain spins
+            with self._lock:
+                self._inflight.pop(seq, None)
+            raise
 
     def _busy(self) -> bool:
         with self._lock:
@@ -253,8 +277,8 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                         batching: bool = False,
                         batch_window_s: float = 0.002,
                         metrics=None,
-                        generation_engines: Optional[Dict[str, object]] = None
-                        ) -> Server:
+                        generation_engines: Optional[Dict[str, object]] = None,
+                        watchdog=None) -> Server:
     """Wire the inference service onto a Server
     (reference BasicInferService ctor infer.cc:644-678).
 
@@ -263,7 +287,8 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     middleman capability, in-process)."""
     resources = InferResources(manager, batching=batching,
                                batch_window_s=batch_window_s, metrics=metrics,
-                               generation_engines=generation_engines)
+                               generation_engines=generation_engines,
+                               watchdog=watchdog)
     server = Server(address, executor or Executor(n_threads=4))
     server._infer_resources = resources  # for shutdown
     service = AsyncService(SERVICE_NAME, resources)
@@ -292,9 +317,11 @@ class GenerateContext(StreamingContext):
     session per request — blocking lease = natural generation backpressure."""
 
     def on_request(self, request: pb.GenerateRequest):
-        """Generation is long-running: under the aio (Fiber) executor the
-        body runs on a worker thread and an awaitable is returned, so the
-        event loop never stalls on decode or on session-pool backpressure."""
+        """Generation is long-running: it always runs on the dedicated
+        'generate' worker pool (never the shared 'pre' pool — long decodes
+        and session-pool waits must not starve StreamInfer/batching); under
+        the aio (Fiber) executor an awaitable is returned so the event loop
+        never stalls."""
         try:
             import asyncio
             asyncio.get_running_loop()
@@ -302,9 +329,11 @@ class GenerateContext(StreamingContext):
             self._run(request)      # thread executor: blocking is fine
             return None
         res = self.get_resources(InferResources)
-        fut = res.manager.workers("pre").enqueue(self._run, request)
+        fut = res.generate_workers().enqueue(self._run, request)
         import asyncio
         return asyncio.wrap_future(fut)
+
+    SESSION_LEASE_TIMEOUT_S = 300.0
 
     def _run(self, request: pb.GenerateRequest) -> None:
         res = self.get_resources(InferResources)
@@ -315,9 +344,15 @@ class GenerateContext(StreamingContext):
                 message=f"no generation engine for {request.model_name!r}")))
             return
         try:
-            with engine.start_session() as session:
+            with engine.start_session(
+                    timeout=self.SESSION_LEASE_TIMEOUT_S) as session:
                 session.prefill(np.asarray(request.prompt, np.int32))
                 for i, tok in enumerate(session.stream(request.steps)):
+                    if (self.grpc_context is not None
+                            and hasattr(self.grpc_context, "is_active")
+                            and not self.grpc_context.is_active()):
+                        log.info("generation cancelled by client at step %d", i)
+                        return  # free the session slot immediately
                     self.write(pb.GenerateResponse(token=tok, index=i))
             self.write(pb.GenerateResponse(
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
@@ -347,18 +382,27 @@ class GenerateStreamClient:
             model_name=self.model_name,
             prompt=list(np.asarray(prompt, np.int32)), steps=steps))
         stream.writes_done()
-        while True:
-            resp = out.get(timeout=timeout)
-            if resp is _STREAM_DEAD:
-                exc = stream.done().exception()
-                raise (exc if exc is not None else RuntimeError(
-                    "generation stream closed before completion"))
-            if resp.final:
-                if resp.status.code not in (pb.SUCCESS, 0):
-                    raise RuntimeError(
-                        f"generation failed: {resp.status.message}")
-                return
-            yield resp.token
+        finished = False
+        try:
+            while True:
+                resp = out.get(timeout=timeout)
+                if resp is _STREAM_DEAD:
+                    finished = True
+                    exc = stream.done().exception()
+                    raise (exc if exc is not None else RuntimeError(
+                        "generation stream closed before completion"))
+                if resp.final:
+                    finished = True
+                    if resp.status.code not in (pb.SUCCESS, 0):
+                        raise RuntimeError(
+                            f"generation failed: {resp.status.message}")
+                    return
+                yield resp.token
+        finally:
+            if not finished:
+                # consumer abandoned the generator mid-stream: cancel so
+                # the server stops decoding and frees the session slot
+                stream.cancel()
 
 
 # -- remote client ------------------------------------------------------------
