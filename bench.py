@@ -18,17 +18,56 @@ import time
 BASELINE_INF_PER_SEC = 953.4  # reference examples/00_TensorRT/README.md:46
 
 
+def _device_canary(deadline_s: float = 240.0) -> bool:
+    """True if the default device completes a tiny compiled dispatch within
+    the deadline.  A wedged device/tunnel otherwise hangs jax calls forever,
+    which would leave the driver with no output at all."""
+    import threading
+    ok = threading.Event()
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+            jax.block_until_ready(
+                jax.jit(lambda a: a @ a)(jnp.ones((64, 64), jnp.float32)))
+            ok.set()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    return ok.wait(deadline_s)
+
+
 def main() -> None:
+    import os
+    from tpulab.tpu.platform import enable_compilation_cache, force_cpu
+
+    degraded = os.environ.get("TPULAB_BENCH_DEGRADED") == "1"
+    if degraded:
+        force_cpu(1)  # before any backend use — config API, env is ignored
+    elif not _device_canary():
+        # wedged device: the canary thread already initialized the backend,
+        # so an in-process platform switch cannot take effect — re-exec with
+        # the degraded marker so the round still records a (flagged) number
+        os.environ["TPULAB_BENCH_DEGRADED"] = "1"
+        os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
+
     import numpy as np
     from tpulab.engine import InferBench, InferenceManager
     from tpulab.models.resnet import make_resnet
     from tpulab.tpu.device_info import DeviceInfo
-    from tpulab.tpu.platform import enable_compilation_cache
 
     enable_compilation_cache()
     t_start = time.time()
-    model = make_resnet(depth=50, max_batch_size=128, input_dtype=np.uint8,
-                        batch_buckets=[1, 8, 128])
+    # degraded (CPU-fallback) mode shrinks the sweep: the number is a
+    # liveness datapoint, not a comparable benchmark
+    buckets = [1, 8] if degraded else [1, 8, 128]
+    sweep = ((1, 2.0), (8, 2.0)) if degraded else \
+        ((1, 5.0), (8, 5.0), (128, 10.0))
+    model = make_resnet(depth=50, max_batch_size=buckets[-1],
+                        input_dtype=np.uint8, batch_buckets=buckets)
     mgr = InferenceManager(max_executions=8, max_buffers=32)
     mgr.register_model("rn50", model)
     mgr.update_resources()
@@ -36,24 +75,27 @@ def main() -> None:
 
     bench = InferBench(mgr)
     results = {}
-    for b, secs in ((1, 5.0), (8, 5.0), (128, 10.0)):
-        r = bench.run("rn50", batch_size=b, seconds=secs, warmup=4)
+    for b, secs in sweep:
+        r = bench.run("rn50", batch_size=b, seconds=secs, warmup=2)
         results[b] = r
-    lat = bench.latency("rn50", batch_size=1, iterations=40)
+    results.setdefault(128, {"inferences_per_second": 0.0})
+    lat = bench.latency("rn50", batch_size=1,
+                        iterations=10 if degraded else 40)
 
     # compute-only ceiling (device-resident input, chained dispatch)
     import jax
     compiled = mgr.compiled("rn50")
+    cb = buckets[-1]
     dev_in = {"input": jax.device_put(
-        np.zeros((128, 224, 224, 3), np.uint8), mgr.device)}
-    jax.block_until_ready(compiled(128, dev_in))
-    n = 30
+        np.zeros((cb, 224, 224, 3), np.uint8), mgr.device)}
+    jax.block_until_ready(compiled(cb, dev_in))
+    n = 3 if degraded else 30
     t0 = time.perf_counter()
     out = None
     for _ in range(n):
-        out = compiled(128, dev_in)
+        out = compiled(cb, dev_in)
     jax.block_until_ready(out)
-    compute_inf_s = 128 * n / (time.perf_counter() - t0)
+    compute_inf_s = cb * n / (time.perf_counter() - t0)
 
     headline = results[1]["inferences_per_second"]
     line = {
@@ -61,7 +103,9 @@ def main() -> None:
         "value": round(headline, 1),
         "unit": "inf/s",
         "vs_baseline": round(headline / BASELINE_INF_PER_SEC, 4),
-        "device": DeviceInfo.device_kind(),
+        "device": DeviceInfo.device_kind() + (" (DEGRADED: device canary "
+                                              "failed, CPU fallback)"
+                                              if degraded else ""),
         "details": {
             "b1_inf_s": round(results[1]["inferences_per_second"], 1),
             "b8_inf_s": round(results[8]["inferences_per_second"], 1),

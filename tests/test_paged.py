@@ -1,0 +1,75 @@
+"""Paged KV cache + continuous batching tests."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpulab.engine.paged import ContinuousBatcher, PagedKVPool
+from tpulab.models.transformer import init_transformer_params, make_generate_fn
+
+
+@pytest.fixture(scope="module")
+def lm():
+    params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                     n_layers=2, d_ff=64)
+    return params
+
+
+def test_paged_matches_dense_generation(lm):
+    """Continuous-batched paged decode == dense KV-cache greedy decode."""
+    dense = make_generate_fn(lm, n_heads=2, n_layers=2, max_len=64,
+                             compute_dtype=jnp.float32)
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=2, max_len=64,
+                           page_size=8, compute_dtype=jnp.float32)
+    try:
+        prompts = [np.random.default_rng(s).integers(0, 64, (5,), np.int32)
+                   for s in range(3)]
+        futs = [cb.submit(p, 7) for p in prompts]
+        for p, f in zip(prompts, futs):
+            got = f.result(timeout=120)
+            want = np.asarray(dense(p[None, :], 7)[0])
+            np.testing.assert_array_equal(np.asarray(got), want)
+    finally:
+        cb.shutdown()
+
+
+def test_continuous_admission(lm):
+    """More requests than lanes: later requests join as lanes free."""
+    dense = make_generate_fn(lm, n_heads=2, n_layers=2, max_len=32,
+                             compute_dtype=jnp.float32)
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=2, max_len=32,
+                           page_size=8, compute_dtype=jnp.float32)
+    try:
+        prompts = [np.full((3,), i + 1, np.int32) for i in range(5)]
+        futs = [cb.submit(p, 4) for p in prompts]
+        outs = [f.result(timeout=120) for f in futs]
+        # every queued-then-admitted request matches its single-request
+        # reference — admission churn must not cross-contaminate lanes
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(
+                np.asarray(o), np.asarray(dense(p[None, :], 4)[0]))
+    finally:
+        cb.shutdown()
+
+
+def test_paged_pool_accounting(lm):
+    pool = PagedKVPool(n_pages=8, page_size=8, n_layers=2, n_heads=2,
+                       head_dim=16, dtype=jnp.float32)
+    pages = [pool.allocate_page() for _ in range(8)]
+    assert pool.allocate_page() is None  # exhausted
+    pool.release_pages(pages)
+    assert pool.free_pages == 8
+    pool.reset()
+    assert pool.free_pages == 8
+
+
+def test_submit_over_capacity_rejected(lm):
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=1, max_len=16,
+                           page_size=8, compute_dtype=jnp.float32)
+    try:
+        with pytest.raises(ValueError, match="max_len"):
+            cb.submit(np.zeros(12, np.int32), 8)
+    finally:
+        cb.shutdown()

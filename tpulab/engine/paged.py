@@ -1,0 +1,314 @@
+"""Paged KV cache + continuous batching.
+
+The dense :mod:`generation` engine leases one max_len cache per session; this
+module is the scalable successor (the TPU literature's ragged/paged-attention
+serving shape): K/V live in a global pool of fixed-size *pages*, sessions own
+*block tables* of page ids, and a scheduler steps every active session in one
+fused batched decode per tick — continuous batching: new requests join the
+batch the moment a slot frees, finished ones leave without draining the rest.
+
+TPU-first mechanics:
+- the page pools are donated through the jitted step, so XLA updates K/V
+  in place (no per-token pool copies);
+- the step has a *static* shape (fixed lane count B, fixed max pages per
+  sequence) — one compiled program regardless of which sessions occupy the
+  lanes; inactive lanes are masked, not recompiled;
+- attention gathers pages via the block table (pool[tables] -> (B, MP*S, ...))
+  and masks by true length.  (A Pallas ragged-paged kernel that skips the
+  gather materialization is the next optimization; the block-table layout is
+  already kernel-ready.)
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class PagedKVPool:
+    """Global paged K/V storage + free-page accounting (host side)."""
+
+    def __init__(self, n_pages: int, page_size: int, n_layers: int,
+                 n_heads: int, head_dim: int, dtype=None, device=None):
+        import jax
+        import jax.numpy as jnp
+        from tpulab.tpu import platform as plat
+
+        dtype = dtype or jnp.bfloat16
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.n_layers = n_layers
+        self.device = device if device is not None else plat.local_device(0)
+        self._shape = (n_layers, n_pages, page_size, n_heads, head_dim)
+        self._dtype = dtype
+        self.k = jax.device_put(jnp.zeros(self._shape, dtype), self.device)
+        self.v = jax.device_put(jnp.zeros(self._shape, dtype), self.device)
+        # page 0 is RESERVED as scratch: inactive/padded lanes scatter their
+        # (masked-out) K/V there, so it must never hold live data
+        self._free: List[int] = list(range(1, n_pages))
+        self._lock = threading.Lock()
+
+    def reset(self) -> None:
+        """Re-materialize the pools (recovery after a failed donated step)."""
+        import jax
+        import jax.numpy as jnp
+        self.k = jax.device_put(jnp.zeros(self._shape, self._dtype), self.device)
+        self.v = jax.device_put(jnp.zeros(self._shape, self._dtype), self.device)
+        with self._lock:
+            self._free = list(range(1, self.n_pages))  # page 0 stays scratch
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def allocate_page(self) -> Optional[int]:
+        with self._lock:
+            return self._free.pop() if self._free else None
+
+    def release_pages(self, pages: List[int]) -> None:
+        with self._lock:
+            self._free.extend(pages)
+
+
+def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
+                      active, n_heads: int, n_layers: int,
+                      compute_dtype):
+    """One batched decode tick over the paged pool.
+
+    Shapes: tables (B, MP) int32 page ids (padded rows repeat page 0),
+    lengths (B,) current position per lane, tokens (B,), active (B,) bool.
+    Returns (logits (B, vocab), k_pool, v_pool) — pools donated by caller.
+    """
+    import jax
+    import jax.numpy as jnp
+    from tpulab.models.transformer import _rmsnorm
+
+    b = tokens.shape[0]
+    page_size = k_pool.shape[2]
+    mp = tables.shape[1]
+    emb = params["embed"].astype(compute_dtype)
+    x = emb[tokens][:, None, :]
+    d_model = x.shape[-1]
+    head_dim = d_model // n_heads
+    # write target per lane: page id + slot for position `lengths`
+    page_idx = tables[jnp.arange(b), lengths // page_size]      # (B,)
+    slot_idx = lengths % page_size                              # (B,)
+
+    for layer in range(n_layers):
+        p = params[f"layer{layer}"]
+        h = _rmsnorm(x, p["ln1"]["scale"])
+        qkv = h @ p["wqkv"].astype(compute_dtype)
+        q, knew, vnew = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, 1, n_heads, head_dim)
+        knew = knew.reshape(b, n_heads, head_dim).astype(k_pool.dtype)
+        vnew = vnew.reshape(b, n_heads, head_dim).astype(v_pool.dtype)
+        # scatter the new K/V into their pages; inactive/padded lanes are
+        # routed to the RESERVED scratch page 0 so they can never clobber
+        # a live lane's pages
+        safe_page = jnp.where(active, page_idx, 0)
+        safe_slot = jnp.where(active, slot_idx, 0)
+        k_pool = k_pool.at[layer, safe_page, safe_slot].set(knew)
+        v_pool = v_pool.at[layer, safe_page, safe_slot].set(vnew)
+        # gather each lane's context pages: (B, MP, S, H, D) -> (B, MP*S, H, D)
+        k_ctx = k_pool[layer][tables].reshape(b, mp * page_size, n_heads,
+                                              head_dim)
+        v_ctx = v_pool[layer][tables].reshape(b, mp * page_size, n_heads,
+                                              head_dim)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            k_ctx.astype(jnp.float32)) / np.sqrt(head_dim)
+        pos = jnp.arange(mp * page_size)
+        mask = pos[None, None, None, :] <= lengths[:, None, None, None]
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(compute_dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                          v_ctx.astype(compute_dtype)).reshape(b, 1, d_model)
+        x = x + attn @ p["wo"].astype(compute_dtype)
+        h2 = _rmsnorm(x, p["ln2"]["scale"])
+        ff = jax.nn.gelu(h2 @ p["w1"].astype(compute_dtype))
+        x = x + ff @ p["w2"].astype(compute_dtype)
+
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    logits = x[:, 0].astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    # inactive lanes emit neutral logits (argmax 0) — callers mask on active
+    logits = jnp.where(active[:, None], logits, 0.0)
+    return logits, k_pool, v_pool
+
+
+class _PagedRequest:
+    __slots__ = ("prompt", "steps", "future", "tokens_out", "pages",
+                 "length", "pending_prompt")
+
+    def __init__(self, prompt: np.ndarray, steps: int):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.steps = steps
+        self.future: Future = Future()
+        self.tokens_out: List[int] = []
+        self.pages: List[int] = []
+        self.length = 0
+        self.pending_prompt = list(self.prompt)
+
+
+class ContinuousBatcher:
+    """Continuous-batching scheduler over the paged pool.
+
+    ``submit(prompt, steps) -> Future[list[int]]``; a background scheduler
+    thread runs one fused decode tick per iteration over up to ``lanes``
+    concurrent requests, admitting queued requests whenever a lane (and
+    pages) free up — no head-of-line draining.
+    """
+
+    def __init__(self, params, n_heads: int, n_layers: int,
+                 pool: Optional[PagedKVPool] = None, lanes: int = 4,
+                 max_len: int = 256, page_size: int = 16,
+                 n_pages: int = 0, compute_dtype=None, device=None):
+        import jax
+        import jax.numpy as jnp
+
+        compute_dtype = compute_dtype or jnp.bfloat16
+        self.lanes = lanes
+        self.max_len = max_len
+        self.page_size = page_size
+        self.max_pages = (max_len + page_size - 1) // page_size
+        d_model = params["layer0"]["wqkv"].shape[0]
+        # +1: page 0 is the reserved scratch page
+        self.pool = pool or PagedKVPool(
+            n_pages or self.max_pages * lanes + 1, page_size, n_layers,
+            n_heads, d_model // n_heads, compute_dtype, device)
+        self.params = jax.device_put(params, self.pool.device)
+        self._step = jax.jit(
+            partial(paged_decode_step, n_heads=n_heads, n_layers=n_layers,
+                    compute_dtype=compute_dtype),
+            donate_argnums=(1, 2))
+        self._queue: List[_PagedRequest] = []
+        self._active: List[Optional[_PagedRequest]] = [None] * lanes
+        self._cv = threading.Condition()
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._run, name="cbatch",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- public -------------------------------------------------------------
+    def submit(self, prompt, steps: int) -> Future:
+        n_prompt = len(np.asarray(prompt).reshape(-1))
+        if n_prompt == 0:
+            raise ValueError("empty prompt")
+        if steps < 1:
+            raise ValueError("steps must be >= 1")
+        if n_prompt + steps > self.max_len:
+            raise ValueError(f"prompt+steps exceeds max_len {self.max_len}")
+        req = _PagedRequest(prompt, steps)
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("ContinuousBatcher is shut down")
+            self._queue.append(req)
+            self._cv.notify()
+        return req.future
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify()
+        self._thread.join(timeout=30)
+
+    @property
+    def active_lanes(self) -> int:
+        with self._cv:
+            return sum(r is not None for r in self._active)
+
+    # -- scheduler ----------------------------------------------------------
+    def _admit_locked(self) -> None:
+        for lane in range(self.lanes):
+            if self._active[lane] is None and self._queue:
+                # needs at least one page to start
+                page = self.pool.allocate_page()
+                if page is None:
+                    return
+                req = self._queue.pop(0)
+                req.pages.append(page)
+                self._active[lane] = req
+
+    def _run(self) -> None:
+        import jax.numpy as jnp
+        while True:
+            with self._cv:
+                while (not self._shutdown and not self._queue
+                       and not any(self._active)):
+                    self._cv.wait()
+                if self._shutdown and not self._queue and not any(self._active):
+                    return
+                self._admit_locked()
+                snapshot = list(self._active)
+            try:
+                progressed = self._tick(snapshot, jnp)
+                if not progressed:
+                    # every lane starved (pool pressure): back off instead
+                    # of hot-spinning until pages free up
+                    with self._cv:
+                        self._cv.wait(timeout=0.01)
+            except Exception as e:  # noqa: BLE001 - fail active requests
+                with self._cv:
+                    for lane, req in enumerate(self._active):
+                        if req is not None:
+                            if not req.future.done():
+                                req.future.set_exception(e)
+                            self._active[lane] = None
+                # donated pools may be gone after a failed step — rebuild
+                self.pool.reset()
+
+    def _tick(self, snapshot, jnp) -> None:
+        tables = np.zeros((self.lanes, self.max_pages), np.int32)
+        lengths = np.zeros((self.lanes,), np.int32)
+        tokens = np.zeros((self.lanes,), np.int32)
+        active = np.zeros((self.lanes,), bool)
+        for lane, req in enumerate(snapshot):
+            if req is None:
+                continue
+            # grow the block table when entering a fresh page
+            if req.length // self.page_size >= len(req.pages):
+                page = self.pool.allocate_page()
+                if page is None:
+                    continue  # pool pressure: lane skips this tick
+                req.pages.append(page)
+            # feed next prompt token, or the feedback token when generating
+            if req.pending_prompt:
+                tokens[lane] = req.pending_prompt[0]
+            elif req.tokens_out:
+                tokens[lane] = req.tokens_out[-1]
+            else:
+                continue  # nothing to feed yet
+            tables[lane, :len(req.pages)] = req.pages
+            lengths[lane] = req.length
+            active[lane] = True
+
+        if not active.any():
+            return False
+        logits, self.pool.k, self.pool.v = self._step(
+            self.params, self.pool.k, self.pool.v,
+            jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(tokens),
+            jnp.asarray(active))
+        next_tokens = np.asarray(logits.argmax(-1), np.int32)
+
+        with self._cv:
+            for lane, req in enumerate(snapshot):
+                if req is None or not active[lane]:
+                    continue
+                req.length += 1
+                if req.pending_prompt:
+                    req.pending_prompt.pop(0)
+                    if not req.pending_prompt:
+                        req.tokens_out.append(int(next_tokens[lane]))
+                else:
+                    req.tokens_out.append(int(next_tokens[lane]))
+                done = len(req.tokens_out) >= req.steps
+                if done:
+                    if not req.future.done():
+                        req.future.set_result(list(req.tokens_out[:req.steps]))
+                    self.pool.release_pages(req.pages)
+                    self._active[lane] = None
+            self._admit_locked()
+        return True

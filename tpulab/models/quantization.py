@@ -1,0 +1,95 @@
+"""Post-training INT8 quantization + calibration.
+
+Reference parity: the INT8 engine-building pipeline
+(examples/ONNX/resnet50/int8.py + calibrator.py builds calibrated INT8
+TensorRT engines; the calibration cache is the checkpointable artifact).
+TPU-native shape of the same capability:
+
+- :func:`quantize_resnet_params` — weight-only INT8 (per-output-channel
+  symmetric absmax scales).  On TPU the win is HBM bandwidth: weights ship
+  4x smaller and dequantize in the conv epilogue (fused by XLA); activation
+  math stays bf16 on the MXU.
+- :class:`Calibrator` — streams calibration batches and records per-layer
+  activation absmax ranges; ``save``/``load`` give the reference's
+  calibration-cache artifact (consumed by future A8 paths).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, Optional
+
+import numpy as np
+
+
+def _quantize_kernel(kernel: np.ndarray) -> Dict[str, Any]:
+    """Per-output-channel symmetric int8 quantization of an HWIO kernel."""
+    import jax.numpy as jnp
+    k = np.asarray(kernel, np.float32)
+    absmax = np.abs(k).reshape(-1, k.shape[-1]).max(axis=0)  # per O channel
+    scale = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(k / scale), -127, 127).astype(np.int8)
+    return {"kernel": jnp.asarray(q), "kernel_scale": jnp.asarray(scale)}
+
+
+def quantize_resnet_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize every conv kernel (stem/blocks) to weight-only INT8; the
+    folded-BN scale/bias and the FC head stay float."""
+    def walk(tree):
+        if isinstance(tree, dict):
+            if "kernel" in tree and "scale" in tree:  # a conv+bn unit
+                out = dict(tree)
+                out.update(_quantize_kernel(tree["kernel"]))
+                return out
+            return {k: walk(v) for k, v in tree.items()}
+        return tree
+
+    return walk(params)
+
+
+def quantized_bytes(params: Dict[str, Any]) -> int:
+    import jax
+    return sum(np.dtype(l.dtype).itemsize * int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(params)
+               if hasattr(l, "shape"))
+
+
+class Calibrator:
+    """Activation-range calibrator (reference calibrator.py).
+
+    Streams batches through an instrumented forward and accumulates per-point
+    absmax.  The recorded ranges are the calibration cache — serializable,
+    reusable across builds (reference write_calibration_cache).
+    """
+
+    def __init__(self, apply_fn, params):
+        self._apply = apply_fn
+        self._params = params
+        self.ranges: Dict[str, float] = {}
+
+    def observe(self, name: str, value) -> None:
+        amax = float(np.abs(np.asarray(value, np.float32)).max())
+        self.ranges[name] = max(self.ranges.get(name, 0.0), amax)
+
+    def run(self, batches: Iterable[Dict[str, np.ndarray]],
+            output_names: Optional[Iterable[str]] = None) -> Dict[str, float]:
+        """Default instrumentation: records input bindings and outputs.
+        Models wanting per-layer ranges call ``observe`` from their apply."""
+        for batch in batches:
+            for name, arr in batch.items():
+                self.observe(f"input:{name}", arr)
+            out = self._apply(self._params, batch)
+            for name, arr in out.items():
+                if output_names is None or name in output_names:
+                    self.observe(f"output:{name}", arr)
+        return dict(self.ranges)
+
+    # -- calibration cache (reference read/write_calibration_cache) ---------
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.ranges, f, indent=2, sort_keys=True)
+
+    @classmethod
+    def load(cls, path: str) -> Dict[str, float]:
+        with open(path) as f:
+            return json.load(f)

@@ -45,7 +45,14 @@ def _init_conv_bn(key, kh, kw, cin, cout):
 
 
 def _conv_bn(params, x, stride=1, relu=True, compute_dtype=jnp.bfloat16):
-    kernel = params["kernel"].astype(compute_dtype)
+    kernel = params["kernel"]
+    if kernel.dtype == jnp.int8:
+        # weight-only INT8: dequantize per output channel in-compute
+        # (XLA fuses the scale into the conv epilogue); 4x less HBM traffic
+        kernel = kernel.astype(compute_dtype) * params["kernel_scale"].astype(
+            compute_dtype)
+    else:
+        kernel = kernel.astype(compute_dtype)
     y = jax.lax.conv_general_dilated(
         x.astype(compute_dtype), kernel,
         window_strides=(stride, stride),
