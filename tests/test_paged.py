@@ -57,12 +57,14 @@ def test_continuous_admission(lm):
 def test_paged_pool_accounting(lm):
     pool = PagedKVPool(n_pages=8, page_size=8, n_layers=2, n_heads=2,
                        head_dim=16, dtype=jnp.float32)
-    pages = [pool.allocate_page() for _ in range(8)]
+    # page 0 is the reserved scratch page -> 7 allocatable
+    pages = [pool.allocate_page() for _ in range(7)]
+    assert 0 not in pages  # scratch page never handed out
     assert pool.allocate_page() is None  # exhausted
     pool.release_pages(pages)
-    assert pool.free_pages == 8
+    assert pool.free_pages == 7
     pool.reset()
-    assert pool.free_pages == 8
+    assert pool.free_pages == 7
 
 
 def test_submit_over_capacity_rejected(lm):

@@ -72,7 +72,7 @@ class PagedKVPool:
 
     def release_pages(self, pages: List[int]) -> None:
         with self._lock:
-            self._free.extend(pages)
+            self._free.extend(p for p in pages if p)  # 0/None never re-enter
 
 
 def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
