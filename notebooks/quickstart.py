@@ -1,0 +1,66 @@
+# %% [markdown]
+# # tpulab Quickstart
+# (reference notebooks: Quickstart.ipynb / Demo Day 1-3 / Multiple Models —
+# as a jupytext percent-format script: open in Jupyter or run as a script)
+#
+# Build a model, register it with an InferenceManager, run local inference,
+# serve it over gRPC, and call it remotely.
+
+# %%
+import numpy as np
+import tpulab
+from tpulab.models import build_model
+
+# %% [markdown]
+# ## 1. Local serving (Demo Day 1)
+
+# %%
+manager = tpulab.InferenceManager(max_exec_concurrency=2)
+manager.register_model("mnist", build_model("mnist", max_batch_size=4))
+manager.update_resources()
+
+runner = manager.infer_runner("mnist")
+x = np.random.default_rng(0).standard_normal((1, 28, 28, 1)).astype(np.float32)
+future = runner.infer(Input3=x)          # async: returns immediately
+outputs = future.result()                # InferFuture.get()
+print("logits:", outputs["Plus214_Output_0"].round(2))
+
+# %% [markdown]
+# ## 2. Multiple models, one device (Multiple Models.ipynb)
+# Per-model context pools share one global execution-token pool — concurrent
+# traffic to any mix of models is bounded by `max_exec_concurrency`.
+
+# %%
+manager2 = tpulab.InferenceManager(max_exec_concurrency=2)
+manager2.register_model("m_a", build_model("mnist", max_batch_size=2, seed=1))
+manager2.register_model("m_b", build_model("mnist", max_batch_size=2, seed=2))
+manager2.update_resources()
+futures = [manager2.infer_runner(m).infer(Input3=x)
+           for m in ("m_a", "m_b") for _ in range(4)]
+print("completed:", len([f.result() for f in futures]))
+manager2.shutdown()
+
+# %% [markdown]
+# ## 3. Serve + remote client (Demo Day 2/3)
+
+# %%
+manager.serve(port=0)                     # TRTIS-style gRPC service
+remote = tpulab.RemoteInferenceManager(f"localhost:{manager.server.bound_port}")
+print("remote models:", sorted(remote.get_models()))
+remote_out = remote.infer_runner("mnist").infer(Input3=x).result()
+np.testing.assert_allclose(remote_out["Plus214_Output_0"],
+                           outputs["Plus214_Output_0"], rtol=1e-5)
+print("remote == local ✓")
+
+# %% [markdown]
+# ## 4. Benchmark (InferBench)
+
+# %%
+from tpulab.engine import InferBench
+
+result = InferBench(manager).run("mnist", batch_size=4, seconds=1.0)
+print({k: round(v, 1) for k, v in result.items()})
+
+# %%
+remote.close()
+manager.shutdown()

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Offline engine builder (reference examples/ONNX/resnet50/build.py +
+models/onnx_builder.py: build serialized engines ahead of serving).
+
+    python tools/build_engine.py --model resnet50 --uint8 --max-batch 128 \
+        --out engines/rn50 [--int8] [--torch-checkpoint path.pt]
+"""
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--uint8", action="store_true")
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only INT8 quantization")
+    ap.add_argument("--torch-checkpoint", default=None,
+                    help="import pretrained torch weights (resnet only)")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        from tpulab.tpu.platform import force_cpu
+        force_cpu(1)
+    import numpy as np
+    from tpulab.engine import Runtime
+    from tpulab.models import build_model
+    from tpulab.tpu.platform import enable_compilation_cache
+
+    enable_compilation_cache()
+    kwargs = dict(max_batch_size=args.max_batch)
+    if args.uint8 and args.model.startswith("resnet"):
+        kwargs["input_dtype"] = np.uint8
+    if args.torch_checkpoint:
+        if not args.model.startswith("resnet"):
+            ap.error("--torch-checkpoint supports resnet models only")
+        from tpulab.models.torch_import import make_resnet_from_torch
+        depth = int(args.model.replace("resnet", "") or 50)
+        model = make_resnet_from_torch(args.torch_checkpoint, depth=depth,
+                                       **kwargs)
+    else:
+        model = build_model(args.model, **kwargs)
+    if args.int8:
+        if not args.model.startswith("resnet"):
+            ap.error("--int8 quantization supports resnet models only")
+        from tpulab.models.quantization import quantize_resnet_params
+        model.params = quantize_resnet_params(model.params)
+
+    t0 = time.time()
+    runtime = Runtime()
+    compiled = runtime.compile_model(model)
+    runtime.save_engine(compiled, args.out)
+    print(json.dumps({
+        "engine": args.out,
+        "model": model.name,
+        "buckets": model.batch_buckets,
+        "weights_bytes": model.weights_size_in_bytes(),
+        "build_s": round(time.time() - t0, 1),
+    }, indent=2))
+
+
+if __name__ == "__main__":
+    main()
