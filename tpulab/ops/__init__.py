@@ -7,8 +7,13 @@ framework owns its hot ops):
 
 - :mod:`flash_attention` — blockwise-softmax attention, O(T) memory,
   MXU-shaped 128x128 tiles (drop-in ``attention_fn`` for the transformer)
+- :mod:`paged_attention` — ragged paged decode attention: per-lane block
+  tables drive HBM->VMEM page DMAs with online softmax (no gather
+  materialization; the kernel-side of engine.paged)
 """
 
 from tpulab.ops.flash_attention import flash_attention, make_flash_attention_fn
+from tpulab.ops.paged_attention import paged_decode_attention
 
-__all__ = ["flash_attention", "make_flash_attention_fn"]
+__all__ = ["flash_attention", "make_flash_attention_fn",
+           "paged_decode_attention"]

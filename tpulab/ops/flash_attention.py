@@ -116,7 +116,8 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
         raise ValueError(f"seq len {t} must divide block sizes "
                          f"({block_q}, {block_k})")
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        from tpulab.tpu.platform import is_tpu
+        interpret = not is_tpu()
 
     def to_bhd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)

@@ -1,0 +1,124 @@
+"""Pallas ragged paged-attention decode kernel.
+
+The decode-attention shape from the TPU serving literature (ragged paged
+attention): each lane attends one query token against its own block table of
+KV pages.  The XLA fallback in :func:`tpulab.engine.paged.paged_decode_step`
+*gathers* every lane's pages into a dense (B, MP*S, H, D) tensor — correct
+but materializes the gather in HBM; this kernel instead walks the block
+table per lane, DMA-ing one K/V page at a time from the pool (HBM) into
+VMEM scratch and accumulating softmax online — O(page) VMEM, no gather
+materialization, and dead pages (beyond the lane's length) are skipped by
+predication.  (The DMAs are currently synchronous per page; double-buffered
+prefetch of page j+1 during page j's compute is the next optimization.)
+
+Scalar-prefetched block tables/lengths drive the page DMAs (the
+PrefetchScalarGridSpec pattern).  ``interpret=True`` (automatic off TPU)
+runs the same kernel on CPU for hermetic tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30
+
+
+def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
+                       o_ref, k_buf, v_buf, sem, *, page_size: int,
+                       max_pages: int, sm_scale: float):
+    lane = pl.program_id(0)
+    length = lengths_ref[lane]                    # tokens visible (incl. current)
+    h, d = q_ref.shape[1], q_ref.shape[2]
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale   # (H, D)
+
+    def body(j, carry):
+        m, l, acc = carry
+        page = tables_ref[lane * max_pages + j]
+
+        def attend(mla):
+            m, l, acc = mla
+            # DMA this page's K/V from the HBM pool into VMEM scratch
+            kd = pltpu.make_async_copy(kpool_ref.at[page], k_buf, sem.at[0])
+            vd = pltpu.make_async_copy(vpool_ref.at[page], v_buf, sem.at[1])
+            kd.start()
+            vd.start()
+            kd.wait()
+            vd.wait()
+            k = k_buf[:].astype(jnp.float32)      # (S, H, D)
+            v = v_buf[:].astype(jnp.float32)
+            s = jnp.einsum("hd,shd->hs", q, k)    # (H, S)
+            pos = j * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, (h, page_size), 1)
+            mask = pos <= length
+            s = jnp.where(mask, s, _NEG)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[:, None]) * mask.astype(jnp.float32)
+            l_new = l * alpha + p.sum(axis=-1)
+            acc_new = acc * alpha[:, None] + jnp.einsum("hs,shd->hd", p, v)
+            return m_new, l_new, acc_new
+
+        # pages fully beyond the lane's length contribute nothing — skip
+        return jax.lax.cond(j * page_size <= length, attend,
+                            lambda mla: mla, (m, l, acc))
+
+    init = (jnp.full((h,), _NEG, jnp.float32),
+            jnp.zeros((h,), jnp.float32),
+            jnp.zeros((h, d), jnp.float32))
+    m, l, acc = jax.lax.fori_loop(0, max_pages, body, init)
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
+    b, h, d = q.shape
+    n_pages, page_size = k_pool.shape[0], k_pool.shape[1]
+    max_pages = tables.shape[1]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # tables (flat), lengths
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda lane, *_: (lane, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),      # K pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # V pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda lane, *_: (lane, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((page_size, h, d), k_pool.dtype),
+            pltpu.VMEM((page_size, h, d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_attn_kernel, page_size=page_size, max_pages=max_pages,
+        sm_scale=1.0 / np.sqrt(d))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(tables.reshape(-1), lengths, q, k_pool, v_pool)
+
+
+def paged_decode_attention(q, k_pool, v_pool, tables, lengths,
+                           interpret: bool | None = None):
+    """Ragged paged decode attention.
+
+    q (B, H, D) — one query token per lane;
+    k_pool/v_pool (P, S, H, D) — one layer's page pool;
+    tables (B, MP) int32 page ids (padded rows point at the scratch page 0);
+    lengths (B,) int32 — the current position per lane (inclusive visibility).
+    Returns (B, H, D).
+    """
+    if interpret is None:
+        from tpulab.tpu.platform import is_tpu
+        interpret = not is_tpu()
+    return _paged_attn(q, k_pool, v_pool, tables.astype(jnp.int32),
+                       lengths.astype(jnp.int32), interpret)
