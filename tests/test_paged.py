@@ -75,3 +75,19 @@ def test_submit_over_capacity_rejected(lm):
             cb.submit(np.zeros(12, np.int32), 8)
     finally:
         cb.shutdown()
+
+
+def test_paged_kernel_flag_matches_fallback(lm):
+    """ContinuousBatcher(use_kernel=True) == XLA-gather fallback."""
+    dense = make_generate_fn(lm, n_heads=2, n_layers=2, max_len=32,
+                             compute_dtype=jnp.float32)
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=2, max_len=32,
+                           page_size=8, compute_dtype=jnp.float32,
+                           use_kernel=True)
+    try:
+        p = np.random.default_rng(9).integers(0, 64, (4,), np.int32)
+        got = cb.submit(p, 5).result(timeout=120)
+        want = np.asarray(dense(p[None, :], 5)[0])
+        np.testing.assert_array_equal(np.asarray(got), want)
+    finally:
+        cb.shutdown()

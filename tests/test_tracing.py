@@ -1,0 +1,22 @@
+"""Tracing/StageTimer tests."""
+
+import numpy as np
+
+from tpulab.utils.tracing import StageTimer, annotate
+
+
+def test_stage_timer_splits():
+    import jax.numpy as jnp
+    t = StageTimer()
+    with t.stage("a"):
+        x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+    with t.stage("b", sync_on=x):
+        y = x * 2
+    assert set(t.stages_ms) == {"a", "b"}
+    assert t.total_ms > 0
+
+
+def test_annotate_runs():
+    import jax.numpy as jnp
+    with annotate("test-region"):
+        (jnp.ones((8, 8)) * 2).block_until_ready()

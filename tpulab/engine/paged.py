@@ -77,7 +77,7 @@ class PagedKVPool:
 
 def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
                       active, n_heads: int, n_layers: int,
-                      compute_dtype):
+                      compute_dtype, use_kernel: bool = False):
     """One batched decode tick over the paged pool.
 
     Shapes: tables (B, MP) int32 page ids (padded rows repeat page 0),
@@ -114,19 +114,28 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
         safe_slot = jnp.where(active, slot_idx, 0)
         k_pool = k_pool.at[layer, safe_page, safe_slot].set(knew)
         v_pool = v_pool.at[layer, safe_page, safe_slot].set(vnew)
-        # gather each lane's context pages: (B, MP, S, H, D) -> (B, MP*S, H, D)
-        k_ctx = k_pool[layer][tables].reshape(b, mp * page_size, n_heads,
-                                              head_dim)
-        v_ctx = v_pool[layer][tables].reshape(b, mp * page_size, n_heads,
-                                              head_dim)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                            k_ctx.astype(jnp.float32)) / np.sqrt(head_dim)
-        pos = jnp.arange(mp * page_size)
-        mask = pos[None, None, None, :] <= lengths[:, None, None, None]
-        scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(compute_dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                          v_ctx.astype(compute_dtype)).reshape(b, 1, d_model)
+        if use_kernel:
+            # pallas ragged kernel: walks block tables page-by-page, no
+            # dense gather materialization (tpulab.ops.paged_attention)
+            from tpulab.ops.paged_attention import paged_decode_attention
+            attn = paged_decode_attention(
+                q[:, 0], k_pool[layer], v_pool[layer], tables, lengths
+            ).astype(compute_dtype).reshape(b, 1, d_model)
+        else:
+            # XLA fallback: gather pages densely then mask
+            k_ctx = k_pool[layer][tables].reshape(b, mp * page_size, n_heads,
+                                                  head_dim)
+            v_ctx = v_pool[layer][tables].reshape(b, mp * page_size, n_heads,
+                                                  head_dim)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                                k_ctx.astype(jnp.float32)) / np.sqrt(head_dim)
+            pos = jnp.arange(mp * page_size)
+            mask = pos[None, None, None, :] <= lengths[:, None, None, None]
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(compute_dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                              v_ctx.astype(compute_dtype)).reshape(b, 1,
+                                                                   d_model)
         x = x + attn @ p["wo"].astype(compute_dtype)
         h2 = _rmsnorm(x, p["ln2"]["scale"])
         ff = jax.nn.gelu(h2 @ p["w1"].astype(compute_dtype))
@@ -165,7 +174,8 @@ class ContinuousBatcher:
     def __init__(self, params, n_heads: int, n_layers: int,
                  pool: Optional[PagedKVPool] = None, lanes: int = 4,
                  max_len: int = 256, page_size: int = 16,
-                 n_pages: int = 0, compute_dtype=None, device=None):
+                 n_pages: int = 0, compute_dtype=None, device=None,
+                 use_kernel: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -182,7 +192,7 @@ class ContinuousBatcher:
         self.params = jax.device_put(params, self.pool.device)
         self._step = jax.jit(
             partial(paged_decode_step, n_heads=n_heads, n_layers=n_layers,
-                    compute_dtype=compute_dtype),
+                    compute_dtype=compute_dtype, use_kernel=use_kernel),
             donate_argnums=(1, 2))
         self._queue: List[_PagedRequest] = []
         self._active: List[Optional[_PagedRequest]] = [None] * lanes

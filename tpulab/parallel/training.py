@@ -41,7 +41,12 @@ def make_sharded_train_step(apply_fn: Callable, params: Any, mesh,
     """
     param_shardings = transformer_param_shardings(params, mesh)
     batch_shardings = {"tokens": shard_batch(mesh), "targets": shard_batch(mesh)}
-    sharded_params = jax.device_put(params, param_shardings)
+    # deep-copy before sharding: device_put may ALIAS the caller's buffers
+    # (same-device replication), and the step donates its params — without
+    # the copy, one step would delete the caller's arrays out from under it
+    sharded_params = jax.device_put(
+        jax.tree_util.tree_map(lambda x: jnp.asarray(x).copy(), params),
+        param_shardings)
 
     def step(p, batch):
         loss, grads = jax.value_and_grad(

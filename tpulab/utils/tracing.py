@@ -1,0 +1,83 @@
+"""Tracing/profiling hooks (SURVEY §5 aux subsystems: the reference has
+per-stage cudaEvent timing + Walltime + InferBench metrics; the TPU
+equivalent adds the XLA profiler).
+
+- :func:`trace` / :func:`annotate` — wrap jax.profiler: capture a
+  TensorBoard-loadable trace of the serving hot path, with named regions
+  (the nvtx-range analog the reference lacked).
+- :class:`StageTimer` — the TimedBenchmarkWorkspace pattern as a reusable
+  context: named stage durations with blocking sync at boundaries.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/tpulab-trace"):
+    """Capture an XLA profiler trace around a block::
+
+        with tracing.trace("/tmp/trace"):
+            runner.infer(**arrays).result()
+        # -> tensorboard --logdir /tmp/trace
+    """
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region inside a trace (nvtx-range analog)."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StageTimer:
+    """Named stage timing (the reference's cudaEvent H2D/compute/D2H split,
+    generalized).  JAX dispatch is async, so each stage that launches device
+    work MUST name a ``sync_on`` target — otherwise the stage records only
+    dispatch time and its device time bleeds into the next stage::
+
+        t = StageTimer()
+        holder = {}
+        with t.stage("h2d"):
+            holder["dev"] = copy_to_device(host)
+        t.sync("h2d", holder["dev"])              # or stage(..., sync_on=...)
+        with t.stage("compute", sync_on_fn=lambda: out):
+            out = compiled(holder["dev"])
+        t.stages_ms  # {"h2d": ..., "compute": ...}
+    """
+
+    def __init__(self):
+        self.stages_ms: Dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str, sync_on=None, sync_on_fn=None):
+        t0 = time.perf_counter()
+        yield
+        target = sync_on_fn() if sync_on_fn is not None else sync_on
+        if target is not None:
+            import jax
+            jax.block_until_ready(target)
+        self.stages_ms[name] = self.stages_ms.get(name, 0.0) + \
+            (time.perf_counter() - t0) * 1e3
+
+    def sync(self, name: str, target) -> None:
+        """Fold a late device sync into an already-recorded stage."""
+        import jax
+        t0 = time.perf_counter()
+        jax.block_until_ready(target)
+        self.stages_ms[name] = self.stages_ms.get(name, 0.0) + \
+            (time.perf_counter() - t0) * 1e3
+
+    @property
+    def total_ms(self) -> float:
+        return sum(self.stages_ms.values())
