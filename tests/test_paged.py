@@ -91,3 +91,36 @@ def test_paged_kernel_flag_matches_fallback(lm):
         np.testing.assert_array_equal(np.asarray(got), want)
     finally:
         cb.shutdown()
+
+
+def test_fused_prefill_matches_dense(lm):
+    """Fused-prefill continuous batching == dense generation, including
+    the steps==1 complete-at-prefill edge and long prompts."""
+    dense = make_generate_fn(lm, n_heads=2, n_layers=2, max_len=64,
+                             compute_dtype=jnp.float32)
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=2, max_len=64,
+                           page_size=8, compute_dtype=jnp.float32)
+    try:
+        rng = np.random.default_rng(3)
+        for t_prompt, steps in ((1, 3), (8, 1), (17, 6), (30, 4)):
+            p = rng.integers(0, 64, (t_prompt,), np.int32)
+            got = cb.submit(p, steps).result(timeout=120)
+            want = np.asarray(dense(p[None, :], steps)[0])
+            np.testing.assert_array_equal(np.asarray(got), want,
+                                          err_msg=f"t={t_prompt} s={steps}")
+    finally:
+        cb.shutdown()
+
+
+def test_on_token_streaming(lm):
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=1, max_len=32,
+                           page_size=8, compute_dtype=jnp.float32)
+    try:
+        streamed = []
+        p = np.random.default_rng(4).integers(0, 64, (4,), np.int32)
+        fut = cb.submit(p, 6, on_token=lambda tok, i: streamed.append((i, tok)))
+        final = fut.result(timeout=120)
+        assert [t for _i, t in sorted(streamed)] == list(final)
+        assert [i for i, _t in sorted(streamed)] == list(range(6))
+    finally:
+        cb.shutdown()

@@ -148,11 +148,43 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
     return logits, k_pool, v_pool
 
 
+def paged_prefill(params, k_pool, v_pool, tables, tokens, valid_len,
+                  n_heads: int, n_layers: int, compute_dtype):
+    """Fused prefill: ONE causal forward over the (padded) prompt, with each
+    layer's K/V scattered straight into the lane's pages.
+
+    tokens (1, T_pad) int32 (padded tail arbitrary), valid_len scalar int32,
+    tables (MP,) page ids for this lane.  Padded positions scatter to the
+    reserved scratch page 0.  Returns (last-valid-token logits (vocab,),
+    k_pool, v_pool) — pools donated by the caller.
+    """
+    import jax
+    import jax.numpy as jnp
+    from tpulab.models.transformer import transformer_forward_collect_kv
+
+    page_size = k_pool.shape[2]
+    t_pad = tokens.shape[1]
+    logits, kvs = transformer_forward_collect_kv(
+        params, tokens, n_heads=n_heads, n_layers=n_layers,
+        compute_dtype=compute_dtype)
+    pos = jnp.arange(t_pad)
+    valid = pos < valid_len
+    page_idx = jnp.where(valid, tables[pos // page_size], 0)  # scratch if pad
+    slot_idx = jnp.where(valid, pos % page_size, 0)
+    for layer, (k, v) in enumerate(kvs):
+        k_pool = k_pool.at[layer, page_idx, slot_idx].set(
+            k[0].astype(k_pool.dtype))
+        v_pool = v_pool.at[layer, page_idx, slot_idx].set(
+            v[0].astype(v_pool.dtype))
+    last = logits[0, valid_len - 1]
+    return last, k_pool, v_pool
+
+
 class _PagedRequest:
     __slots__ = ("prompt", "steps", "future", "tokens_out", "pages",
-                 "length", "pending_prompt")
+                 "length", "pending_prompt", "on_token")
 
-    def __init__(self, prompt: np.ndarray, steps: int):
+    def __init__(self, prompt: np.ndarray, steps: int, on_token=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.steps = steps
         self.future: Future = Future()
@@ -160,6 +192,7 @@ class _PagedRequest:
         self.pages: List[int] = []
         self.length = 0
         self.pending_prompt = list(self.prompt)
+        self.on_token = on_token
 
 
 class ContinuousBatcher:
@@ -194,6 +227,11 @@ class ContinuousBatcher:
             partial(paged_decode_step, n_heads=n_heads, n_layers=n_layers,
                     compute_dtype=compute_dtype, use_kernel=use_kernel),
             donate_argnums=(1, 2))
+        # fused prefill, compiled per prompt-length bucket (powers of two)
+        self._prefill = jax.jit(
+            partial(paged_prefill, n_heads=n_heads, n_layers=n_layers,
+                    compute_dtype=compute_dtype),
+            donate_argnums=(1, 2))
         self._queue: List[_PagedRequest] = []
         self._active: List[Optional[_PagedRequest]] = [None] * lanes
         self._cv = threading.Condition()
@@ -203,7 +241,9 @@ class ContinuousBatcher:
         self._thread.start()
 
     # -- public -------------------------------------------------------------
-    def submit(self, prompt, steps: int) -> Future:
+    def submit(self, prompt, steps: int, on_token=None) -> Future:
+        """``on_token(token, index)`` (optional) streams tokens as they
+        decode — the hook the Generate RPC rides for paged serving."""
         n_prompt = len(np.asarray(prompt).reshape(-1))
         if n_prompt == 0:
             raise ValueError("empty prompt")
@@ -211,7 +251,7 @@ class ContinuousBatcher:
             raise ValueError("steps must be >= 1")
         if n_prompt + steps > self.max_len:
             raise ValueError(f"prompt+steps exceeds max_len {self.max_len}")
-        req = _PagedRequest(prompt, steps)
+        req = _PagedRequest(prompt, steps, on_token=on_token)
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("ContinuousBatcher is shut down")
@@ -254,7 +294,20 @@ class ContinuousBatcher:
                 self._admit_locked()
                 snapshot = list(self._active)
             try:
-                progressed = self._tick(snapshot, jnp)
+                prefilled = False
+                for req in snapshot:
+                    if req is not None and req.pending_prompt:
+                        prefilled |= self._do_prefill(req, jnp)
+                if prefilled:
+                    # a steps==1 request can complete at prefill
+                    with self._cv:
+                        for lane, req in enumerate(self._active):
+                            if (req is not None and not req.pending_prompt
+                                    and len(req.tokens_out) >= req.steps):
+                                self._finish_locked(lane, req)
+                        self._admit_locked()
+                        snapshot = list(self._active)
+                progressed = self._tick(snapshot, jnp) or prefilled
                 if not progressed:
                     # every lane starved (pool pressure): back off instead
                     # of hot-spinning until pages free up
@@ -270,6 +323,43 @@ class ContinuousBatcher:
                 # donated pools may be gone after a failed step — rebuild
                 self.pool.reset()
 
+    def _do_prefill(self, req: _PagedRequest, jnp) -> bool:
+        """Fused prompt prefill: one compiled forward (per length bucket)
+        fills the whole prompt's KV pages.  Returns False (retry later) when
+        the pool can't yet supply the prompt's pages."""
+        if req.length != 0:  # never mix with already-started lanes
+            return False
+        t = len(req.pending_prompt)
+        needed = (t + self.page_size - 1) // self.page_size
+        while len(req.pages) < needed:
+            page = self.pool.allocate_page()
+            if page is None:
+                return False  # page pressure — prefill retries next round
+            req.pages.append(page)
+        t_pad = 1 << (t - 1).bit_length()  # pow2 bucket -> small jit cache
+        tokens = np.zeros((1, t_pad), np.int32)
+        tokens[0, :t] = req.pending_prompt
+        tables = np.zeros((self.max_pages,), np.int32)
+        tables[:len(req.pages)] = req.pages
+        last_logits, self.pool.k, self.pool.v = self._prefill(
+            self.params, self.pool.k, self.pool.v, jnp.asarray(tables),
+            jnp.asarray(tokens), jnp.int32(t))
+        req.length = t
+        req.pending_prompt = []
+        self._emit(req, int(np.asarray(last_logits).argmax()))
+        return True
+
+    @staticmethod
+    def _emit(req: _PagedRequest, token: int) -> None:
+        req.tokens_out.append(token)
+        if req.on_token is not None:
+            try:
+                req.on_token(token, len(req.tokens_out) - 1)
+            except Exception:  # pragma: no cover - consumer hook
+                import logging
+                logging.getLogger("tpulab.engine").exception(
+                    "on_token hook failed")
+
     def _tick(self, snapshot, jnp) -> None:
         tables = np.zeros((self.lanes, self.max_pages), np.int32)
         lengths = np.zeros((self.lanes,), np.int32)
@@ -284,13 +374,11 @@ class ContinuousBatcher:
                 if page is None:
                     continue  # pool pressure: lane skips this tick
                 req.pages.append(page)
-            # feed next prompt token, or the feedback token when generating
-            if req.pending_prompt:
-                tokens[lane] = req.pending_prompt[0]
-            elif req.tokens_out:
-                tokens[lane] = req.tokens_out[-1]
-            else:
-                continue  # nothing to feed yet
+            # prompts are handled by the fused prefill; decode feeds back the
+            # previously generated token
+            if req.pending_prompt or not req.tokens_out:
+                continue
+            tokens[lane] = req.tokens_out[-1]
             tables[lane, :len(req.pages)] = req.pages
             lengths[lane] = req.length
             active[lane] = True
@@ -308,17 +396,14 @@ class ContinuousBatcher:
                 if req is None or not active[lane]:
                     continue
                 req.length += 1
-                if req.pending_prompt:
-                    req.pending_prompt.pop(0)
-                    if not req.pending_prompt:
-                        req.tokens_out.append(int(next_tokens[lane]))
-                else:
-                    req.tokens_out.append(int(next_tokens[lane]))
-                done = len(req.tokens_out) >= req.steps
-                if done:
-                    if not req.future.done():
-                        req.future.set_result(list(req.tokens_out[:req.steps]))
-                    self.pool.release_pages(req.pages)
-                    self._active[lane] = None
+                self._emit(req, int(next_tokens[lane]))
+                if len(req.tokens_out) >= req.steps:
+                    self._finish_locked(lane, req)
             self._admit_locked()
         return True
+
+    def _finish_locked(self, lane: int, req: _PagedRequest) -> None:
+        if not req.future.done():
+            req.future.set_result(list(req.tokens_out[:req.steps]))
+        self.pool.release_pages(req.pages)
+        self._active[lane] = None

@@ -213,3 +213,34 @@ def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
         return jnp.concatenate([first[:, None], toks.T], axis=1)
 
     return jax.jit(generate, static_argnums=1)
+
+
+def transformer_forward_collect_kv(params: Dict[str, Any],
+                                   tokens: jnp.ndarray,
+                                   n_heads: int = 8, n_layers: int = 6,
+                                   compute_dtype=jnp.bfloat16):
+    """Causal forward over (B, T) tokens that also returns each layer's
+    K/V (B, T, H, Dh) — the fused-prefill building block: one forward fills
+    a whole prompt's KV instead of T decode steps."""
+    emb = params["embed"].astype(compute_dtype)
+    x = emb[tokens]
+    b, t, d_model = x.shape
+    head_dim = d_model // n_heads
+    kvs = []
+    for i in range(n_layers):
+        p = params[f"layer{i}"]
+        h = _rmsnorm(x, p["ln1"]["scale"])
+        qkv = h @ p["wqkv"].astype(compute_dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, n_heads, head_dim)
+        k = k.reshape(b, t, n_heads, head_dim)
+        v = v.reshape(b, t, n_heads, head_dim)
+        kvs.append((k, v))
+        attn = causal_attention(q, k, v).reshape(b, t, d_model)
+        x = x + attn @ p["wo"].astype(compute_dtype)
+        h = _rmsnorm(x, p["ln2"]["scale"])
+        ff = jax.nn.gelu(h @ p["w1"].astype(compute_dtype))
+        x = x + ff @ p["w2"].astype(compute_dtype)
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    return logits, kvs
