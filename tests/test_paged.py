@@ -124,3 +124,42 @@ def test_on_token_streaming(lm):
         assert [i for i, _t in sorted(streamed)] == list(range(6))
     finally:
         cb.shutdown()
+
+
+def test_generate_rpc_over_continuous_batcher(lm):
+    """The Generate RPC can serve straight from the paged batcher: many
+    concurrent RPC streams share fused decode ticks."""
+    import tpulab
+    from tpulab.models.mnist import make_mnist
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=4, max_len=32,
+                           page_size=8, compute_dtype=jnp.float32)
+    dense = make_generate_fn(lm, n_heads=2, n_layers=2, max_len=32,
+                             compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": cb})
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        import threading
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 64, (5,), np.int32) for _ in range(6)]
+        results = [None] * 6
+
+        def gen(i):
+            results[i] = list(GenerateStreamClient(remote, "lm").generate(
+                prompts[i], 5))
+
+        threads = [threading.Thread(target=gen, args=(i,)) for i in range(6)]
+        [t.start() for t in threads]
+        [t.join(timeout=180) for t in threads]
+        for p, got in zip(prompts, results):
+            want = np.asarray(dense(p[None, :], 5)[0])
+            np.testing.assert_array_equal(np.asarray(got), want)
+    finally:
+        remote.close()
+        mgr.shutdown()
+        cb.shutdown()

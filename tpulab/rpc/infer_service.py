@@ -343,6 +343,9 @@ class GenerateContext(StreamingContext):
                 code=pb.UNKNOWN_MODEL,
                 message=f"no generation engine for {request.model_name!r}")))
             return
+        if hasattr(engine, "submit"):  # paged ContinuousBatcher engine
+            self._run_paged(engine, request)
+            return
         try:
             with engine.start_session(
                     timeout=self.SESSION_LEASE_TIMEOUT_S) as session:
@@ -358,6 +361,22 @@ class GenerateContext(StreamingContext):
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
         except Exception as e:  # noqa: BLE001
             log.exception("generation failed")
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INTERNAL, message=str(e))))
+
+    def _run_paged(self, engine, request: pb.GenerateRequest) -> None:
+        """Continuous-batching path: tokens stream from the batcher's
+        on_token hook; many RPCs share the fused decode ticks."""
+        try:
+            fut = engine.submit(
+                np.asarray(request.prompt, np.int32), request.steps,
+                on_token=lambda tok, i: self.write(
+                    pb.GenerateResponse(token=tok, index=i)))
+            fut.result(timeout=self.SESSION_LEASE_TIMEOUT_S)
+            self.write(pb.GenerateResponse(
+                final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
+        except Exception as e:  # noqa: BLE001
+            log.exception("paged generation failed")
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
                 code=pb.INTERNAL, message=str(e))))
 
