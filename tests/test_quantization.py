@@ -60,3 +60,35 @@ def test_calibrator_ranges_and_cache(tmp_path, rn_params):
     path = str(tmp_path / "calib.json")
     cal.save(path)
     assert Calibrator.load(path) == ranges
+
+
+def test_w8a8_calibrated_accuracy(rn_params):
+    """Full INT8 path: calibrate ranges -> W8A8 -> outputs track float."""
+    from tpulab.models.quantization import (calibrate_resnet,
+                                            quantize_resnet_params_w8a8)
+    rng = np.random.default_rng(0)
+    batches = [rng.standard_normal((1, 64, 64, 3)).astype(np.float32)
+               for _ in range(3)]
+    ranges = calibrate_resnet(rn_params, batches)
+    assert "stem" in ranges and "s0b0/conv1" in ranges
+    assert all(v > 0 for v in ranges.values())
+    q = quantize_resnet_params_w8a8(rn_params, ranges)
+    assert q["stem"]["kernel"].dtype == jnp.int8
+    assert float(q["stem"]["act_scale"]) > 0
+    x = {"input": batches[0]}
+    full = np.asarray(resnet_apply(rn_params, x, compute_dtype=jnp.float32)["logits"])
+    w8a8 = np.asarray(resnet_apply(q, x, compute_dtype=jnp.float32)["logits"])
+    corr = np.corrcoef(full.ravel(), w8a8.ravel())[0, 1]
+    assert corr > 0.95, f"correlation {corr}"
+
+
+def test_w8a8_out_of_range_input_clips_not_explodes(rn_params):
+    """Inputs beyond the calibrated range saturate (int8 clip), finite out."""
+    from tpulab.models.quantization import (calibrate_resnet,
+                                            quantize_resnet_params_w8a8)
+    small = [np.full((1, 32, 32, 3), 0.1, np.float32)]
+    ranges = calibrate_resnet(rn_params, small)   # tiny calibrated ranges
+    q = quantize_resnet_params_w8a8(rn_params, ranges)
+    wild = {"input": np.full((1, 32, 32, 3), 50.0, np.float32)}
+    out = np.asarray(resnet_apply(q, wild, compute_dtype=jnp.float32)["logits"])
+    assert np.isfinite(out).all()

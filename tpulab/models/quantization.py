@@ -47,6 +47,43 @@ def quantize_resnet_params(params: Dict[str, Any]) -> Dict[str, Any]:
     return walk(params)
 
 
+def calibrate_resnet(params: Dict[str, Any],
+                     batches: Iterable[np.ndarray],
+                     depth: int = 50) -> Dict[str, float]:
+    """Per-conv-unit activation absmax over calibration batches (the
+    reference calibrator's per-layer ranges)."""
+    import jax
+    from tpulab.models.resnet import resnet_collect_amax
+    collect = jax.jit(resnet_collect_amax, static_argnames=("depth",))
+    ranges: Dict[str, float] = {}
+    for x in batches:
+        amax = collect(params, np.asarray(x, np.float32), depth=depth)
+        for name, v in amax.items():
+            ranges[name] = max(ranges.get(name, 0.0), float(v))
+    return ranges
+
+
+def quantize_resnet_params_w8a8(params: Dict[str, Any],
+                                act_ranges: Dict[str, float]) -> Dict[str, Any]:
+    """Full INT8 (W8A8): int8 weights per channel + calibrated per-unit
+    activation scales; convs run int8 x int8 -> int32 on the MXU."""
+    import jax.numpy as jnp
+
+    def walk(tree, prefix=""):
+        if isinstance(tree, dict):
+            if "kernel" in tree and "scale" in tree:  # a conv+bn unit
+                out = dict(tree)
+                out.update(_quantize_kernel(tree["kernel"]))
+                amax = act_ranges.get(prefix.lstrip("/"))
+                if amax is not None and amax > 0:
+                    out["act_scale"] = jnp.float32(amax / 127.0)
+                return out
+            return {k: walk(v, f"{prefix}/{k}") for k, v in tree.items()}
+        return tree
+
+    return walk(params)
+
+
 def quantized_bytes(params: Dict[str, Any]) -> int:
     import jax
     return sum(np.dtype(l.dtype).itemsize * int(np.prod(l.shape))

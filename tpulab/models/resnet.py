@@ -44,21 +44,40 @@ def _init_conv_bn(key, kh, kw, cin, cout):
     }
 
 
-def _conv_bn(params, x, stride=1, relu=True, compute_dtype=jnp.bfloat16):
+def _conv_bn(params, x, stride=1, relu=True, compute_dtype=jnp.bfloat16,
+             name="", observe=None):
+    if observe is not None:
+        # calibration mode: record this unit's input activation absmax
+        observe[name] = jnp.abs(x.astype(jnp.float32)).max()
     kernel = params["kernel"]
-    if kernel.dtype == jnp.int8:
-        # weight-only INT8: dequantize per output channel in-compute
-        # (XLA fuses the scale into the conv epilogue); 4x less HBM traffic
-        kernel = kernel.astype(compute_dtype) * params["kernel_scale"].astype(
-            compute_dtype)
+    if kernel.dtype == jnp.int8 and "act_scale" in params:
+        # W8A8: quantize the activation with the calibrated scale, run the
+        # conv in int8 with int32 accumulation (MXU-native), dequantize in
+        # the epilogue with act_scale * per-channel kernel_scale
+        act_scale = params["act_scale"].astype(jnp.float32)
+        xq = jnp.clip(jnp.round(x.astype(jnp.float32) / act_scale),
+                      -127, 127).astype(jnp.int8)
+        y = jax.lax.conv_general_dilated(
+            xq, kernel, window_strides=(stride, stride), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.int32)
+        y = (y.astype(jnp.float32)
+             * (act_scale * params["kernel_scale"].astype(jnp.float32))
+             ).astype(compute_dtype)
     else:
-        kernel = kernel.astype(compute_dtype)
-    y = jax.lax.conv_general_dilated(
-        x.astype(compute_dtype), kernel,
-        window_strides=(stride, stride),
-        padding="SAME",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
+        if kernel.dtype == jnp.int8:
+            # weight-only INT8: dequantize per output channel in-compute
+            # (XLA fuses the scale into the conv epilogue); 4x less HBM
+            kernel = kernel.astype(compute_dtype) * \
+                params["kernel_scale"].astype(compute_dtype)
+        else:
+            kernel = kernel.astype(compute_dtype)
+        y = jax.lax.conv_general_dilated(
+            x.astype(compute_dtype), kernel,
+            window_strides=(stride, stride),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
     y = y * params["scale"].astype(compute_dtype) + params["bias"].astype(compute_dtype)
     if relu:
         y = jax.nn.relu(y)
@@ -77,14 +96,18 @@ def _init_bottleneck(key, cin, cmid, cout, stride):
     return p
 
 
-def _bottleneck(params, x, stride, compute_dtype):
+def _bottleneck(params, x, stride, compute_dtype, name="", observe=None):
     """v1.5 bottleneck: stride on the 3x3 conv."""
     residual = x
-    y = _conv_bn(params["conv1"], x, 1, True, compute_dtype)
-    y = _conv_bn(params["conv2"], y, stride, True, compute_dtype)
-    y = _conv_bn(params["conv3"], y, 1, False, compute_dtype)
+    y = _conv_bn(params["conv1"], x, 1, True, compute_dtype,
+                 f"{name}/conv1", observe)
+    y = _conv_bn(params["conv2"], y, stride, True, compute_dtype,
+                 f"{name}/conv2", observe)
+    y = _conv_bn(params["conv3"], y, 1, False, compute_dtype,
+                 f"{name}/conv3", observe)
     if "proj" in params:
-        residual = _conv_bn(params["proj"], x, stride, False, compute_dtype)
+        residual = _conv_bn(params["proj"], x, stride, False, compute_dtype,
+                            f"{name}/proj", observe)
     return jax.nn.relu(y + residual.astype(y.dtype))
 
 
@@ -118,7 +141,8 @@ IMAGENET_STD = (0.229, 0.224, 0.225)
 
 
 def resnet_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
-                 depth: int = 50, compute_dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+                 depth: int = 50, compute_dtype=jnp.bfloat16,
+                 observe: Dict[str, Any] = None) -> Dict[str, jnp.ndarray]:
     """Forward pass: NHWC image -> logits (binding names: input / logits).
 
     uint8 inputs are normalized on device ((x/255 - mean)/std in bf16) — the
@@ -131,18 +155,28 @@ def resnet_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
         mean = jnp.asarray(IMAGENET_MEAN, compute_dtype) * 255.0
         std = jnp.asarray(IMAGENET_STD, compute_dtype) * 255.0
         x = (x.astype(compute_dtype) - mean) / std
-    y = _conv_bn(params["stem"], x, 2, True, compute_dtype)
+    y = _conv_bn(params["stem"], x, 2, True, compute_dtype, "stem", observe)
     y = jax.lax.reduce_window(
         y, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
         [(0, 0), (1, 1), (1, 1), (0, 0)])
     for stage, blocks in enumerate(STAGE_SIZES[depth]):
         for block in range(blocks):
             stride = 2 if (block == 0 and stage > 0) else 1
-            y = _bottleneck(params[f"s{stage}b{block}"], y, stride, compute_dtype)
+            y = _bottleneck(params[f"s{stage}b{block}"], y, stride,
+                            compute_dtype, f"s{stage}b{block}", observe)
     y = jnp.mean(y, axis=(1, 2))  # global average pool
     logits = (y.astype(jnp.float32) @ params["fc"]["kernel"]
               + params["fc"]["bias"])
     return {"logits": logits}
+
+
+def resnet_collect_amax(params, x, depth: int = 50,
+                        compute_dtype=jnp.float32):
+    """Calibration forward: per-conv-unit input-activation absmax
+    (the per-layer ranges the reference's INT8 calibrator records)."""
+    observe: Dict[str, jnp.ndarray] = {}
+    resnet_apply(params, {"input": x}, depth, compute_dtype, observe=observe)
+    return observe
 
 
 def make_resnet(depth: int = 50, num_classes: int = 1000,
