@@ -163,3 +163,21 @@ def test_generate_rpc_over_continuous_batcher(lm):
         remote.close()
         mgr.shutdown()
         cb.shutdown()
+
+
+def test_cancel_frees_lane_and_pages(lm):
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=1, max_len=64,
+                           page_size=8, compute_dtype=jnp.float32)
+    try:
+        p = np.zeros(4, np.int32)
+        f1 = cb.submit(p, 50)          # long generation holds the only lane
+        f2 = cb.submit(p, 3)           # queued behind it
+        cb.cancel(f1)
+        out2 = f2.result(timeout=120)  # cancel freed the lane for f2
+        assert len(out2) == 3
+        with pytest.raises(Exception):
+            f1.result(timeout=5)
+        # all non-scratch pages back
+        assert cb.pool.free_pages == cb.pool.n_pages - 1
+    finally:
+        cb.shutdown()

@@ -182,7 +182,7 @@ def paged_prefill(params, k_pool, v_pool, tables, tokens, valid_len,
 
 class _PagedRequest:
     __slots__ = ("prompt", "steps", "future", "tokens_out", "pages",
-                 "length", "pending_prompt", "on_token")
+                 "length", "pending_prompt", "on_token", "cancelled")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -193,6 +193,7 @@ class _PagedRequest:
         self.length = 0
         self.pending_prompt = list(self.prompt)
         self.on_token = on_token
+        self.cancelled = False
 
 
 class ContinuousBatcher:
@@ -201,8 +202,12 @@ class ContinuousBatcher:
     ``submit(prompt, steps) -> Future[list[int]]``; a background scheduler
     thread runs one fused decode tick per iteration over up to ``lanes``
     concurrent requests, admitting queued requests whenever a lane (and
-    pages) free up — no head-of-line draining.
+    pages) free up — no head-of-line draining.  ``cancel(future)`` aborts a
+    request and frees its lane/pages at the next tick boundary.
     """
+
+    #: explicit capability marker for routers (e.g. the Generate RPC)
+    continuous_batching = True
 
     def __init__(self, params, n_heads: int, n_layers: int,
                  pool: Optional[PagedKVPool] = None, lanes: int = 4,
@@ -233,6 +238,7 @@ class ContinuousBatcher:
                     compute_dtype=compute_dtype),
             donate_argnums=(1, 2))
         self._queue: List[_PagedRequest] = []
+        self._requests: Dict[Future, _PagedRequest] = {}
         self._active: List[Optional[_PagedRequest]] = [None] * lanes
         self._cv = threading.Condition()
         self._shutdown = False
@@ -256,8 +262,21 @@ class ContinuousBatcher:
             if self._shutdown:
                 raise RuntimeError("ContinuousBatcher is shut down")
             self._queue.append(req)
+            self._requests[req.future] = req
             self._cv.notify()
         return req.future
+
+    def cancel(self, future: Future) -> None:
+        """Abort a submitted request (freed at the next tick boundary)."""
+        with self._cv:
+            req = self._requests.get(future)
+            if req is not None:
+                req.cancelled = True
+                if req in self._queue:  # never started: finish immediately
+                    self._queue.remove(req)
+                    self._requests.pop(future, None)
+        if req is not None and req not in self._active and not future.done():
+            future.cancel()
 
     def shutdown(self) -> None:
         with self._cv:
@@ -300,13 +319,19 @@ class ContinuousBatcher:
                         prefilled |= self._do_prefill(req, jnp)
                 if prefilled:
                     # a steps==1 request can complete at prefill
+                    done_reqs = []
                     with self._cv:
                         for lane, req in enumerate(self._active):
                             if (req is not None and not req.pending_prompt
                                     and len(req.tokens_out) >= req.steps):
-                                self._finish_locked(lane, req)
+                                self._release_lane_locked(lane, req)
+                                done_reqs.append(req)
                         self._admit_locked()
                         snapshot = list(self._active)
+                    for req in done_reqs:
+                        if not req.future.done():
+                            req.future.set_result(
+                                list(req.tokens_out[:req.steps]))
                 progressed = self._tick(snapshot, jnp) or prefilled
                 if not progressed:
                     # every lane starved (pool pressure): back off instead
@@ -319,6 +344,7 @@ class ContinuousBatcher:
                         if req is not None:
                             if not req.future.done():
                                 req.future.set_exception(e)
+                            self._requests.pop(req.future, None)
                             self._active[lane] = None
                 # donated pools may be gone after a failed step — rebuild
                 self.pool.reset()
@@ -346,15 +372,16 @@ class ContinuousBatcher:
             jnp.asarray(tokens), jnp.int32(t))
         req.length = t
         req.pending_prompt = []
-        self._emit(req, int(np.asarray(last_logits).argmax()))
+        tok = int(np.asarray(last_logits).argmax())
+        req.tokens_out.append(tok)
+        self._emit(req, tok, 0)
         return True
 
     @staticmethod
-    def _emit(req: _PagedRequest, token: int) -> None:
-        req.tokens_out.append(token)
+    def _emit(req: _PagedRequest, token: int, index: int) -> None:
         if req.on_token is not None:
             try:
-                req.on_token(token, len(req.tokens_out) - 1)
+                req.on_token(token, index)
             except Exception:  # pragma: no cover - consumer hook
                 import logging
                 logging.getLogger("tpulab.engine").exception(
@@ -391,19 +418,41 @@ class ContinuousBatcher:
             jnp.asarray(active))
         next_tokens = np.asarray(logits.argmax(-1), np.int32)
 
+        emits: List = []
+        completed: List = []
+        cancelled: List = []
         with self._cv:
             for lane, req in enumerate(snapshot):
-                if req is None or not active[lane]:
+                if req is None:
+                    continue
+                if req.cancelled:
+                    self._release_lane_locked(lane, req)
+                    cancelled.append(req)
+                    continue
+                if not active[lane]:
                     continue
                 req.length += 1
-                self._emit(req, int(next_tokens[lane]))
+                req.tokens_out.append(int(next_tokens[lane]))
+                emits.append((req, req.tokens_out[-1],
+                              len(req.tokens_out) - 1))
                 if len(req.tokens_out) >= req.steps:
-                    self._finish_locked(lane, req)
+                    self._release_lane_locked(lane, req)
+                    completed.append(req)
             self._admit_locked()
+        # user callbacks and future resolution OUTSIDE the scheduler lock:
+        # a slow consumer must not head-of-line-block other lanes
+        for req, tok, i in emits:
+            self._emit(req, tok, i)
+        for req in completed:
+            if not req.future.done():
+                req.future.set_result(list(req.tokens_out[:req.steps]))
+        for req in cancelled:
+            if not req.future.done():
+                req.future.cancel() or req.future.set_exception(
+                    RuntimeError("generation cancelled"))
         return True
 
-    def _finish_locked(self, lane: int, req: _PagedRequest) -> None:
-        if not req.future.done():
-            req.future.set_result(list(req.tokens_out[:req.steps]))
+    def _release_lane_locked(self, lane: int, req: _PagedRequest) -> None:
         self.pool.release_pages(req.pages)
         self._active[lane] = None
+        self._requests.pop(req.future, None)

@@ -34,17 +34,9 @@ def _quantize_kernel(kernel: np.ndarray) -> Dict[str, Any]:
 
 def quantize_resnet_params(params: Dict[str, Any]) -> Dict[str, Any]:
     """Quantize every conv kernel (stem/blocks) to weight-only INT8; the
-    folded-BN scale/bias and the FC head stay float."""
-    def walk(tree):
-        if isinstance(tree, dict):
-            if "kernel" in tree and "scale" in tree:  # a conv+bn unit
-                out = dict(tree)
-                out.update(_quantize_kernel(tree["kernel"]))
-                return out
-            return {k: walk(v) for k, v in tree.items()}
-        return tree
-
-    return walk(params)
+    folded-BN scale/bias and the FC head stay float.  (Weight-only is the
+    W8A8 walker with no activation ranges.)"""
+    return quantize_resnet_params_w8a8(params, {})
 
 
 def calibrate_resnet(params: Dict[str, Any],

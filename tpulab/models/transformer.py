@@ -60,17 +60,14 @@ def causal_attention(q, k, v):
     return dense_attention(q, k, v, causal=True)
 
 
-def transformer_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
-                      n_heads: int = 8, n_layers: int = 6,
-                      compute_dtype=jnp.bfloat16,
-                      attention_fn: Callable = causal_attention
-                      ) -> Dict[str, jnp.ndarray]:
-    """tokens (B, T) int32 -> logits (B, T, vocab) f32."""
-    tokens = inputs["tokens"]
+def _forward(params, tokens, n_heads, n_layers, compute_dtype, attention_fn,
+             collect_kv: bool = False):
+    """Shared transformer trunk: (B, T) tokens -> (logits, kvs or None)."""
     emb = params["embed"].astype(compute_dtype)
     x = emb[tokens]
     b, t, d_model = x.shape
     head_dim = d_model // n_heads
+    kvs = [] if collect_kv else None
     for i in range(n_layers):
         p = params[f"layer{i}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
@@ -79,6 +76,8 @@ def transformer_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
         q = q.reshape(b, t, n_heads, head_dim)
         k = k.reshape(b, t, n_heads, head_dim)
         v = v.reshape(b, t, n_heads, head_dim)
+        if collect_kv:
+            kvs.append((k, v))
         attn = attention_fn(q, k, v).reshape(b, t, d_model)
         x = x + attn @ p["wo"].astype(compute_dtype)
         h = _rmsnorm(x, p["ln2"]["scale"])
@@ -86,6 +85,17 @@ def transformer_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
         x = x + ff @ p["w2"].astype(compute_dtype)
     x = _rmsnorm(x, params["final_norm"]["scale"])
     logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    return logits, kvs
+
+
+def transformer_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
+                      n_heads: int = 8, n_layers: int = 6,
+                      compute_dtype=jnp.bfloat16,
+                      attention_fn: Callable = causal_attention
+                      ) -> Dict[str, jnp.ndarray]:
+    """tokens (B, T) int32 -> logits (B, T, vocab) f32."""
+    logits, _ = _forward(params, inputs["tokens"], n_heads, n_layers,
+                         compute_dtype, attention_fn)
     return {"logits": logits}
 
 
@@ -218,29 +228,11 @@ def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
 def transformer_forward_collect_kv(params: Dict[str, Any],
                                    tokens: jnp.ndarray,
                                    n_heads: int = 8, n_layers: int = 6,
-                                   compute_dtype=jnp.bfloat16):
+                                   compute_dtype=jnp.bfloat16,
+                                   attention_fn: Callable = causal_attention):
     """Causal forward over (B, T) tokens that also returns each layer's
     K/V (B, T, H, Dh) — the fused-prefill building block: one forward fills
-    a whole prompt's KV instead of T decode steps."""
-    emb = params["embed"].astype(compute_dtype)
-    x = emb[tokens]
-    b, t, d_model = x.shape
-    head_dim = d_model // n_heads
-    kvs = []
-    for i in range(n_layers):
-        p = params[f"layer{i}"]
-        h = _rmsnorm(x, p["ln1"]["scale"])
-        qkv = h @ p["wqkv"].astype(compute_dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, t, n_heads, head_dim)
-        k = k.reshape(b, t, n_heads, head_dim)
-        v = v.reshape(b, t, n_heads, head_dim)
-        kvs.append((k, v))
-        attn = causal_attention(q, k, v).reshape(b, t, d_model)
-        x = x + attn @ p["wo"].astype(compute_dtype)
-        h = _rmsnorm(x, p["ln2"]["scale"])
-        ff = jax.nn.gelu(h @ p["w1"].astype(compute_dtype))
-        x = x + ff @ p["w2"].astype(compute_dtype)
-    x = _rmsnorm(x, params["final_norm"]["scale"])
-    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
-    return logits, kvs
+    a whole prompt's KV instead of T decode steps.  Shares the trunk with
+    :func:`transformer_apply` (single source of truth)."""
+    return _forward(params, tokens, n_heads, n_layers, compute_dtype,
+                    attention_fn, collect_kv=True)

@@ -343,7 +343,7 @@ class GenerateContext(StreamingContext):
                 code=pb.UNKNOWN_MODEL,
                 message=f"no generation engine for {request.model_name!r}")))
             return
-        if hasattr(engine, "submit"):  # paged ContinuousBatcher engine
+        if getattr(engine, "continuous_batching", False):  # explicit marker
             self._run_paged(engine, request)
             return
         try:
@@ -366,16 +366,45 @@ class GenerateContext(StreamingContext):
 
     def _run_paged(self, engine, request: pb.GenerateRequest) -> None:
         """Continuous-batching path: tokens stream from the batcher's
-        on_token hook; many RPCs share the fused decode ticks."""
+        on_token hook; many RPCs share the fused decode ticks.  Client
+        disconnects cancel the batcher request (lane/pages free at the next
+        tick), and nothing is written after the final response."""
+        import concurrent.futures as _f
+        import time as _time
+        finished = [False]
+
+        def on_token(tok, i):
+            if not finished[0]:
+                self.write(pb.GenerateResponse(token=tok, index=i))
+
+        fut = None
         try:
-            fut = engine.submit(
-                np.asarray(request.prompt, np.int32), request.steps,
-                on_token=lambda tok, i: self.write(
-                    pb.GenerateResponse(token=tok, index=i)))
-            fut.result(timeout=self.SESSION_LEASE_TIMEOUT_S)
+            fut = engine.submit(np.asarray(request.prompt, np.int32),
+                                request.steps, on_token=on_token)
+            deadline = _time.monotonic() + self.SESSION_LEASE_TIMEOUT_S
+            while True:
+                try:
+                    fut.result(timeout=1.0)
+                    break
+                except _f.TimeoutError:
+                    if _time.monotonic() > deadline:
+                        raise
+                    if (self.grpc_context is not None
+                            and hasattr(self.grpc_context, "is_active")
+                            and not self.grpc_context.is_active()):
+                        engine.cancel(fut)  # client gone: free the lane
+                        finished[0] = True
+                        return
+            finished[0] = True
             self.write(pb.GenerateResponse(
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
         except Exception as e:  # noqa: BLE001
+            finished[0] = True
+            if fut is not None:
+                try:
+                    engine.cancel(fut)
+                except Exception:  # pragma: no cover
+                    pass
             log.exception("paged generation failed")
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
                 code=pb.INTERNAL, message=str(e))))
