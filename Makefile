@@ -1,0 +1,37 @@
+# tpulab build/test targets (reference Makefile/build.sh analog).
+PY ?= python
+
+.PHONY: all native test test-native bench bench-native bench-host dryrun \
+        engine clean
+
+all: native test
+
+native:
+	cmake -S cpp -B cpp/build -G Ninja
+	ninja -C cpp/build
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-native: native
+	./cpp/build/test_native
+
+bench-native: native
+	./cpp/build/bench_native
+
+bench:
+	$(PY) bench.py
+
+bench-host:
+	$(PY) benchmarks/bench_host.py
+
+dryrun:
+	$(PY) __graft_entry__.py 8
+
+engine:
+	$(PY) tools/build_engine.py --model resnet50 --uint8 \
+	    --max-batch 128 --out engines/rn50
+
+clean:
+	rm -rf cpp/build .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

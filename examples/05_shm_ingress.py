@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Zero-copy shared-memory ingress (reference examples/02's SysV shm input
+path, server.cc:110-137: clients place tensor bytes in shared memory; the
+server binds them without a socket copy).
+
+Run as one command — it spawns the producer as a child process:
+
+    python examples/05_shm_ingress.py
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    if ap.parse_args().cpu:
+        from tpulab.tpu.platform import force_cpu
+        force_cpu(1)
+    from tpulab.engine import InferenceManager
+    from tpulab.memory.allocator import make_allocator
+    from tpulab.memory.shm import SharedMemoryAllocator
+    from tpulab.models import build_model
+
+    # serving process owns a shared staging segment
+    shm_raw = SharedMemoryAllocator(prefix="tpulab_demo")
+    alloc = make_allocator(shm_raw)
+    desc = alloc.allocate_descriptor(28 * 28 * 4, 64)
+    segment = shm_raw.segment_name(desc.addr)
+    print(f"server segment: {segment}")
+
+    # a separate PRODUCER process fills the segment (no socket, no copy)
+    producer = (
+        "import numpy as np\n"
+        "from tpulab.memory.shm import SharedMemoryAllocator\n"
+        f"seg = SharedMemoryAllocator.attach('{segment}')\n"
+        "arr = seg.numpy(np.float32, (28, 28))\n"
+        "arr[:] = np.fromfunction(lambda i, j: (i + j) / 56.0, (28, 28))\n"
+        "seg.close()\n"
+        "print('producer: wrote 28x28 image into shared memory')\n"
+    )
+    subprocess.run([sys.executable, "-c", producer], check=True, timeout=120)
+
+    # the server binds the SAME memory as the model input — zero-copy ingress
+    mgr = InferenceManager(max_executions=1)
+    mgr.register_model("mnist", build_model("mnist", max_batch_size=1))
+    mgr.update_resources()
+    image = desc.numpy(np.float32, (1, 28, 28, 1))
+    out = mgr.infer_runner("mnist").infer(Input3=image).result(timeout=120)
+    print(f"served from shm: logits {out['Plus214_Output_0'].shape}, "
+          f"argmax {int(out['Plus214_Output_0'].argmax())}")
+    mgr.shutdown()
+    desc.release()
+    shm_raw.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -123,3 +123,69 @@ def test_tpu_cyclic_windowed_stack():
     assert [w for w, _ in seen] == [0, 1, 2, 3]
     assert all(s == 64.0 for _, s in seen)
     stack.release()
+
+
+def test_transfer_engine_direct_mode():
+    import jax.numpy as jnp
+    from tpulab.tpu.transfer import TransferEngine
+    eng = TransferEngine()
+    try:
+        trees = [{"a": jnp.full((8,), i, jnp.float32), "n": i}
+                 for i in range(10)]
+        futs = [eng.fetch(t) for t in trees]
+        outs = [f.result(timeout=30) for f in futs]
+        for i, out in enumerate(outs):
+            assert isinstance(out["a"], np.ndarray)
+            assert out["a"][0] == i and out["n"] == i  # non-arrays pass through
+    finally:
+        eng.shutdown()
+
+
+def test_transfer_engine_stack_mode_groups_same_shape():
+    import jax.numpy as jnp
+    from tpulab.tpu.transfer import TransferEngine
+    eng = TransferEngine(mode="stack")
+    try:
+        futs = [eng.fetch(jnp.full((4, 4), i, jnp.float32)) for i in range(9)]
+        outs = [f.result(timeout=30) for f in futs]
+        for i, out in enumerate(outs):
+            np.testing.assert_array_equal(out, np.full((4, 4), i, np.float32))
+    finally:
+        eng.shutdown()
+
+
+def test_transfer_engine_rejects_after_shutdown():
+    from tpulab.tpu.transfer import TransferEngine
+    eng = TransferEngine()
+    eng.shutdown()
+    with pytest.raises(RuntimeError):
+        eng.fetch({"x": np.zeros(2)})
+
+
+def test_event_poller_fires_on_ready():
+    import threading
+    import jax.numpy as jnp
+    from tpulab.tpu.sync import EventPoller
+    poller = EventPoller(interval_s=0.001)
+    try:
+        done = threading.Event()
+        x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+        poller.watch({"out": x}, done.set)
+        assert done.wait(timeout=10)
+        # plain values (no is_ready) fire immediately
+        done2 = threading.Event()
+        poller.watch({"n": 3}, done2.set)
+        assert done2.wait(timeout=10)
+    finally:
+        poller.shutdown()
+
+
+def test_benchmark_workspace_run():
+    from tpulab.engine import BenchmarkWorkspace
+    from tpulab.models.mnist import make_mnist
+    ws = BenchmarkWorkspace(make_mnist(max_batch_size=2), batch_size=2)
+    ws.host_inputs["Input3"][:] = 0.5
+    ws.run()
+    ws.synchronize()
+    ws.async_d2h()
+    assert np.isfinite(ws.host_outputs["Plus214_Output_0"]).all()

@@ -310,8 +310,19 @@ class ContinuousBatcher:
                     self._cv.wait()
                 if self._shutdown and not self._queue and not any(self._active):
                     return
+                # cancellation sweep: unconditional, so cancels land even
+                # when no lane can make progress (page-starved prefills)
+                swept = []
+                for lane, req in enumerate(self._active):
+                    if req is not None and req.cancelled:
+                        self._release_lane_locked(lane, req)
+                        swept.append(req)
                 self._admit_locked()
                 snapshot = list(self._active)
+            for req in swept:
+                if not req.future.done():
+                    req.future.cancel() or req.future.set_exception(
+                        RuntimeError("generation cancelled"))
             try:
                 prefilled = False
                 for req in snapshot:
@@ -353,14 +364,18 @@ class ContinuousBatcher:
         """Fused prompt prefill: one compiled forward (per length bucket)
         fills the whole prompt's KV pages.  Returns False (retry later) when
         the pool can't yet supply the prompt's pages."""
-        if req.length != 0:  # never mix with already-started lanes
+        if req.cancelled or req.length != 0:  # swept / already started
             return False
         t = len(req.pending_prompt)
         needed = (t + self.page_size - 1) // self.page_size
         while len(req.pages) < needed:
             page = self.pool.allocate_page()
             if page is None:
-                return False  # page pressure — prefill retries next round
+                # page pressure: release partial holdings before retrying —
+                # two starved prefills must not hold-and-wait each other
+                self.pool.release_pages(req.pages)
+                req.pages = []
+                return False
             req.pages.append(page)
         t_pad = 1 << (t - 1).bit_length()  # pow2 bucket -> small jit cache
         tokens = np.zeros((1, t_pad), np.int32)
@@ -420,15 +435,12 @@ class ContinuousBatcher:
 
         emits: List = []
         completed: List = []
-        cancelled: List = []
         with self._cv:
             for lane, req in enumerate(snapshot):
                 if req is None:
                     continue
                 if req.cancelled:
-                    self._release_lane_locked(lane, req)
-                    cancelled.append(req)
-                    continue
+                    continue  # the _run sweep releases it next round
                 if not active[lane]:
                     continue
                 req.length += 1
@@ -446,10 +458,6 @@ class ContinuousBatcher:
         for req in completed:
             if not req.future.done():
                 req.future.set_result(list(req.tokens_out[:req.steps]))
-        for req in cancelled:
-            if not req.future.done():
-                req.future.cancel() or req.future.set_exception(
-                    RuntimeError("generation cancelled"))
         return True
 
     def _release_lane_locked(self, lane: int, req: _PagedRequest) -> None:
