@@ -180,11 +180,41 @@ def paged_prefill(params, k_pool, v_pool, tables, tokens, valid_len,
     return last, k_pool, v_pool
 
 
+class SamplingParams:
+    """Host-side token selection policy (greedy by default; temperature /
+    top-k sampling with a per-request PRNG for reproducibility)."""
+
+    __slots__ = ("temperature", "top_k", "_rng")
+
+    def __init__(self, temperature: float = 0.0, top_k: int = 0,
+                 seed: Optional[int] = None):
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        self.temperature = temperature
+        self.top_k = top_k
+        self._rng = np.random.default_rng(seed)
+
+    def pick(self, logits: np.ndarray) -> int:
+        """Select the next token from a (vocab,) logits row."""
+        if self.temperature == 0.0:
+            return int(logits.argmax())
+        z = logits.astype(np.float64) / self.temperature
+        if self.top_k > 0 and self.top_k < z.shape[0]:
+            kth = np.partition(z, -self.top_k)[-self.top_k]
+            z = np.where(z >= kth, z, -np.inf)
+        z = z - z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(z.shape[0], p=p))
+
+
 class _PagedRequest:
     __slots__ = ("prompt", "steps", "future", "tokens_out", "pages",
-                 "length", "pending_prompt", "on_token", "cancelled")
+                 "length", "pending_prompt", "on_token", "cancelled",
+                 "sampling")
 
-    def __init__(self, prompt: np.ndarray, steps: int, on_token=None):
+    def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
+                 sampling: Optional[SamplingParams] = None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.steps = steps
         self.future: Future = Future()
@@ -194,6 +224,7 @@ class _PagedRequest:
         self.pending_prompt = list(self.prompt)
         self.on_token = on_token
         self.cancelled = False
+        self.sampling = sampling or SamplingParams()
 
 
 class ContinuousBatcher:
@@ -247,9 +278,11 @@ class ContinuousBatcher:
         self._thread.start()
 
     # -- public -------------------------------------------------------------
-    def submit(self, prompt, steps: int, on_token=None) -> Future:
+    def submit(self, prompt, steps: int, on_token=None,
+               sampling: Optional[SamplingParams] = None) -> Future:
         """``on_token(token, index)`` (optional) streams tokens as they
-        decode — the hook the Generate RPC rides for paged serving."""
+        decode — the hook the Generate RPC rides for paged serving.
+        ``sampling`` selects the token policy (default greedy)."""
         n_prompt = len(np.asarray(prompt).reshape(-1))
         if n_prompt == 0:
             raise ValueError("empty prompt")
@@ -257,7 +290,8 @@ class ContinuousBatcher:
             raise ValueError("steps must be >= 1")
         if n_prompt + steps > self.max_len:
             raise ValueError(f"prompt+steps exceeds max_len {self.max_len}")
-        req = _PagedRequest(prompt, steps, on_token=on_token)
+        req = _PagedRequest(prompt, steps, on_token=on_token,
+                            sampling=sampling)
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("ContinuousBatcher is shut down")
@@ -387,7 +421,7 @@ class ContinuousBatcher:
             jnp.asarray(tokens), jnp.int32(t))
         req.length = t
         req.pending_prompt = []
-        tok = int(np.asarray(last_logits).argmax())
+        tok = req.sampling.pick(np.asarray(last_logits))
         req.tokens_out.append(tok)
         self._emit(req, tok, 0)
         return True
@@ -431,7 +465,17 @@ class ContinuousBatcher:
             self.params, self.pool.k, self.pool.v,
             jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(tokens),
             jnp.asarray(active))
-        next_tokens = np.asarray(logits.argmax(-1), np.int32)
+        # greedy lanes ride a device-side argmax; sampling lanes pull their
+        # logits row and pick host-side (per-request PRNG)
+        all_greedy = all(req is None or req.sampling.temperature == 0.0
+                         for req in snapshot)
+        if all_greedy:
+            next_tokens = np.asarray(logits.argmax(-1), np.int32)
+        else:
+            logits_host = np.asarray(logits)
+            next_tokens = np.asarray(
+                [req.sampling.pick(logits_host[lane]) if req is not None
+                 else 0 for lane, req in enumerate(snapshot)], np.int32)
 
         emits: List = []
         completed: List = []
