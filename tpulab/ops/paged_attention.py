@@ -8,8 +8,8 @@ but materializes the gather in HBM; this kernel instead walks the block
 table per lane, DMA-ing one K/V page at a time from the pool (HBM) into
 VMEM scratch and accumulating softmax online — O(page) VMEM, no gather
 materialization, and dead pages (beyond the lane's length) are skipped by
-predication.  (The DMAs are currently synchronous per page; double-buffered
-prefetch of page j+1 during page j's compute is the next optimization.)
+predication.  Page DMAs are double-buffered: page j+1 prefetches into the
+alternate VMEM slot while page j computes.
 
 Scalar-prefetched block tables/lengths drive the page DMAs (the
 PrefetchScalarGridSpec pattern).  ``interpret=True`` (automatic off TPU)
@@ -38,21 +38,42 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
 
     q = q_ref[0].astype(jnp.float32) * sm_scale   # (H, D)
 
+    def start_dma(j, slot):
+        page = tables_ref[lane * max_pages + j]
+        pltpu.make_async_copy(kpool_ref.at[page], k_buf.at[slot],
+                              sem.at[slot, 0]).start()
+        pltpu.make_async_copy(vpool_ref.at[page], v_buf.at[slot],
+                              sem.at[slot, 1]).start()
+
+    def wait_dma(j, slot):
+        page = tables_ref[lane * max_pages + j]
+        pltpu.make_async_copy(kpool_ref.at[page], k_buf.at[slot],
+                              sem.at[slot, 0]).wait()
+        pltpu.make_async_copy(vpool_ref.at[page], v_buf.at[slot],
+                              sem.at[slot, 1]).wait()
+
+    def live(j):
+        return j * page_size <= length
+
+    # double buffering: prologue fetches page 0; each attend prefetches
+    # page j+1 into the other slot before computing page j.  live(j) is
+    # monotone decreasing, so every started DMA is waited exactly once.
+    start_dma(0, 0)  # page 0 is always live (length >= 0)
+
     def body(j, carry):
         m, l, acc = carry
-        page = tables_ref[lane * max_pages + j]
+        slot = jax.lax.rem(j, 2)
 
         def attend(mla):
             m, l, acc = mla
-            # DMA this page's K/V from the HBM pool into VMEM scratch
-            kd = pltpu.make_async_copy(kpool_ref.at[page], k_buf, sem.at[0])
-            vd = pltpu.make_async_copy(vpool_ref.at[page], v_buf, sem.at[1])
-            kd.start()
-            vd.start()
-            kd.wait()
-            vd.wait()
-            k = k_buf[:].astype(jnp.float32)      # (S, H, D)
-            v = v_buf[:].astype(jnp.float32)
+            wait_dma(j, slot)
+
+            @pl.when(jnp.logical_and(j + 1 < max_pages, live(j + 1)))
+            def _prefetch():
+                start_dma(j + 1, jax.lax.rem(j + 1, 2))
+
+            k = k_buf[slot].astype(jnp.float32)   # (S, H, D)
+            v = v_buf[slot].astype(jnp.float32)
             s = jnp.einsum("hd,shd->hs", q, k)    # (H, S)
             pos = j * page_size + jax.lax.broadcasted_iota(
                 jnp.int32, (h, page_size), 1)
@@ -66,8 +87,7 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
             return m_new, l_new, acc_new
 
         # pages fully beyond the lane's length contribute nothing — skip
-        return jax.lax.cond(j * page_size <= length, attend,
-                            lambda mla: mla, (m, l, acc))
+        return jax.lax.cond(live(j), attend, lambda mla: mla, (m, l, acc))
 
     init = (jnp.full((h,), _NEG, jnp.float32),
             jnp.zeros((h,), jnp.float32),
@@ -91,9 +111,9 @@ def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
         ],
         out_specs=pl.BlockSpec((1, h, d), lambda lane, *_: (lane, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((page_size, h, d), k_pool.dtype),
-            pltpu.VMEM((page_size, h, d), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((2, page_size, h, d), k_pool.dtype),  # double buffer
+            pltpu.VMEM((2, page_size, h, d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),                 # [slot][k/v]
         ],
     )
     kernel = functools.partial(
