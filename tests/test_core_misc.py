@@ -1,0 +1,53 @@
+"""Coverage for the remaining small core surfaces: thread-type policies,
+dtypes, literals."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tpulab.core import standard_threads, userspace_threads
+from tpulab.core import dtypes
+from tpulab.memory.literals import align_down, align_up, ilog2, is_aligned
+
+
+def test_standard_threads_policy():
+    fut = standard_threads.async_(lambda a, b: a + b, 2, 3)
+    assert fut.result(timeout=5) == 5
+    m = standard_threads.Mutex()
+    with m:
+        pass
+    assert standard_threads.make_future() is not None
+
+
+def test_userspace_threads_policy():
+    async def scenario():
+        fut = userspace_threads.make_future()
+        task = userspace_threads.async_(userspace_threads.sleep(0.01))
+        await task
+        fut.set_result(7)
+        return await fut
+
+    assert asyncio.run(scenario()) == 7
+
+
+def test_dtype_table_and_compat():
+    assert dtypes.float32.to_numpy() == np.dtype(np.float32)
+    assert dtypes.bfloat16.to_numpy().name == "bfloat16"
+    assert dtypes.int8.itemsize == 1 and dtypes.float64.itemsize == 8
+    assert dtypes.float32.is_compatible(np.float32)
+    assert not dtypes.float32.is_compatible(np.int32)
+    assert str(dtypes.bfloat16) == "bfloat16"
+    assert dtypes.dtype_from_numpy(np.uint16) is dtypes.uint16
+    with pytest.raises(TypeError):
+        dtypes.dtype_from_numpy(np.complex64)
+
+
+def test_align_helpers():
+    assert align_up(100, 64) == 128 and align_down(100, 64) == 64
+    assert is_aligned(128, 64) and not is_aligned(100, 64)
+    assert ilog2(1024) == 10
+    with pytest.raises(ValueError):
+        align_up(1, 3)  # non power of two
+    with pytest.raises(ValueError):
+        ilog2(0)
