@@ -70,14 +70,21 @@ void* TransactionalAllocator::allocate(size_t size, size_t alignment) {
 
 bool TransactionalAllocator::deallocate(void* ptr) {
   std::lock_guard<std::mutex> lk(mu_);
-  Stack* s = reinterpret_cast<Stack**>(ptr)[-1];
-  // validate the header against live stacks (guards invalid frees)
-  if (std::find(stacks_.begin(), stacks_.end(), s) == stacks_.end())
-    return false;
-  uintptr_t base = reinterpret_cast<uintptr_t>(s->base);
+  // range-check against live stacks BEFORE touching the in-band header:
+  // reading header bytes of an arbitrary address could itself fault
   uintptr_t p = reinterpret_cast<uintptr_t>(ptr);
-  if (p < base + kHeader || p > base + arena_->block_size()) return false;
-  if (--s->refs == 0 && s->retired) release_stack_locked(s);
+  Stack* owner = nullptr;
+  for (Stack* s : stacks_) {
+    uintptr_t base = reinterpret_cast<uintptr_t>(s->base);
+    if (p >= base + kHeader && p <= base + arena_->block_size()) {
+      owner = s;
+      break;
+    }
+  }
+  if (!owner) return false;
+  // header must agree with the containing stack (guards interior garbage)
+  if (reinterpret_cast<Stack**>(ptr)[-1] != owner) return false;
+  if (--owner->refs == 0 && owner->retired) release_stack_locked(owner);
   return true;
 }
 

@@ -26,6 +26,7 @@ class ThreadPool:
             raise ValueError("need at least one thread")
         self._tasks: "queue.Queue" = queue.Queue()
         self._shutdown = False
+        self._state_lock = threading.Lock()
         self._name = name
         self._threads: List[threading.Thread] = []
         for i in range(n_threads):
@@ -39,6 +40,7 @@ class ThreadPool:
         self = cls.__new__(cls)
         self._tasks = queue.Queue()
         self._shutdown = False
+        self._state_lock = threading.Lock()
         self._name = name
         self._threads = []
         for cpu in cpus:
@@ -74,20 +76,24 @@ class ThreadPool:
 
     def enqueue(self, fn: Callable, *args, **kwargs) -> Future:
         """Submit work; returns a future (reference ThreadPool::enqueue)."""
-        if self._shutdown:
-            raise RuntimeError("enqueue on stopped ThreadPool")
         fut: Future = Future()
-        self._tasks.put((fn, args, kwargs, fut))
+        # the flag check and the put are one atomic step: a task enqueued
+        # behind shutdown sentinels would never run and never resolve
+        with self._state_lock:
+            if self._shutdown:
+                raise RuntimeError("enqueue on stopped ThreadPool")
+            self._tasks.put((fn, args, kwargs, fut))
         return fut
 
     submit = enqueue  # concurrent.futures-style alias
 
     def shutdown(self, wait: bool = True) -> None:
-        if self._shutdown:
-            return
-        self._shutdown = True
-        for _ in self._threads:
-            self._tasks.put(None)
+        with self._state_lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            for _ in self._threads:
+                self._tasks.put(None)
         if wait:
             for t in self._threads:
                 t.join(timeout=10)

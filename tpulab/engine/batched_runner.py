@@ -16,6 +16,12 @@ On TPU this is the decisive serving lever: per-dispatch and per-transfer
 fixed costs amortize across the group, and the bucketed batch programs stay
 hot.  Latency bound follows the reference's formula (examples/03/README:23-25):
 ``window + batchN_compute - batch1_compute``.
+
+Why this does not wrap core.Dispatcher: the core batcher counts *items*
+(one promise per batch), while request aggregation must account *rows*
+(requests carry batch dims, overflow must flush-then-open, and every caller
+needs its own sliced future).  The window/seq machinery is intentionally the
+same shape so the two stay reviewable side by side.
 """
 
 from __future__ import annotations
@@ -179,11 +185,13 @@ class BatchedInferRunner:
 
     def _make_split(self, group: List[dict], offsets):
         def split(bindings):
-            self.last_compute_s = getattr(bindings, "compute_seconds", None)
+            cs = getattr(bindings, "compute_seconds", None)
+            self.last_compute_s = cs
             outs = bindings.outputs()
             for i, it in enumerate(group):
                 lo, hi = offsets[i], offsets[i + 1]
                 if not it["future"].done():
+                    it["future"]._tpulab_compute_s = cs  # per-request timing
                     it["future"].set_result(
                         {k: v[lo:hi].copy() for k, v in outs.items()})
         return lambda b: (split(b), None)[1]

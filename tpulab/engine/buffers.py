@@ -76,6 +76,9 @@ class Bindings:
         self.host_outputs: Dict[str, np.ndarray] = {}
         self.device_inputs: Dict[str, Any] = {}
         self.device_outputs: Dict[str, Any] = {}
+        #: set by the coalesced-fetch post stage: private host arrays that
+        #: outputs() prefers over the staging views (saves a copy)
+        self.fetched_outputs: Dict[str, np.ndarray] = {}
         for spec in model.inputs:
             raw = buffers._carve(spec.bytes_per_sample() * self.bucket)
             arr = raw.view(spec.np_dtype).reshape(spec.batched_shape(self.bucket))
@@ -138,7 +141,10 @@ class Bindings:
                 for n in self.host_outputs}
 
     def outputs(self) -> Dict[str, np.ndarray]:
-        """Unpadded host outputs (valid after synchronize)."""
+        """Unpadded host outputs (valid after synchronize / fetch)."""
+        if self.fetched_outputs:
+            return {n: arr[:self.batch_size]
+                    for n, arr in self.fetched_outputs.items()}
         return {n: self.host_outputs[n][:self.batch_size]
                 for n in self.host_outputs}
 
@@ -147,3 +153,4 @@ class Bindings:
         self.host_outputs.clear()
         self.device_inputs.clear()
         self.device_outputs.clear()
+        self.fetched_outputs = {}

@@ -108,10 +108,14 @@ class InferRunner:
                             task: SharedPackagedTask, fetch_fut) -> None:
         try:
             host = fetch_fut.result()
-            for name, arr in host.items():
-                out = bindings.host_outputs.get(name)
-                if out is not None:
-                    np.copyto(out, arr)
+            # hand the fetched private arrays to outputs() directly — no
+            # staging round trip; the default post_fn then pays ONE copy
+            # (slice-to-batch) instead of copy-in + copy-out
+            bindings.fetched_outputs = host
+            # per-request compute-site timing for metrics consumers (read
+            # after .result(); avoids the shared-attr race)
+            task.get_future()._tpulab_compute_s = getattr(
+                bindings, "compute_seconds", None)
             task(bindings)                               # user post fn -> future
         except BaseException as e:  # noqa: BLE001
             if not task.get_future().done():

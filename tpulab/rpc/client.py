@@ -137,3 +137,6 @@ class ClientStreaming:
 
     def cancel(self) -> None:
         self._call.cancel()
+        # unblock grpc's request-consumer thread: it sits in Queue.get()
+        # inside request_iter and cancel alone cannot interrupt it
+        self._writes.put(_WRITES_DONE)

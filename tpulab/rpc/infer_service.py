@@ -157,10 +157,12 @@ class InferContext(Context):
             import time as _time
             runner = res.runner(request.model_name)
             t0 = _time.monotonic()
-            outputs = runner.infer(**arrays).result()
-            # prefer the compute-site measurement (device dispatch -> ready);
-            # the wait-time fallback includes queueing/window (see runner)
-            compute_s = (getattr(runner, "last_compute_s", None)
+            fut = runner.infer(**arrays)
+            outputs = fut.result()
+            # prefer the per-request compute-site measurement (set on the
+            # future before resolution — race-free); the wait-time fallback
+            # includes queueing/window
+            compute_s = (getattr(fut, "_tpulab_compute_s", None)
                          or (_time.monotonic() - t0))
             wanted = set(request.requested_outputs) or set(outputs)
             for name, arr in outputs.items():
@@ -514,11 +516,15 @@ class StreamInferClient:
             fut = self._pending.pop(resp.correlation_id, None)
         if fut is None:
             return
-        if resp.status.code != pb.SUCCESS:
-            fut.set_exception(RuntimeError(
-                f"stream inference failed: {resp.status.message}"))
-        else:
-            fut.set_result({t.name: proto_to_tensor(t) for t in resp.outputs})
+        try:
+            if resp.status.code != pb.SUCCESS:
+                raise RuntimeError(
+                    f"stream inference failed: {resp.status.message}")
+            result = {t.name: proto_to_tensor(t) for t in resp.outputs}
+        except Exception as e:  # malformed tensors must fail THIS future,
+            fut.set_exception(e)  # not strand it
+            return
+        fut.set_result(result)
 
     def submit(self, **arrays: np.ndarray):
         from concurrent.futures import Future
@@ -529,6 +535,14 @@ class StreamInferClient:
             cid = self._next_id
             self._next_id += 1
             self._pending[cid] = fut
+        if self._stream.done().done():
+            # stream already died: _on_stream_done may have run before this
+            # registration — fail now rather than stranding the caller
+            with self._lock:
+                self._pending.pop(cid, None)
+            exc = self._stream.done().exception()
+            fut.set_exception(exc or RuntimeError("stream is closed"))
+            return fut
         req = pb.InferRequest(model_name=self.model_name,
                               batch_size=next(iter(arrays.values())).shape[0],
                               correlation_id=cid)
