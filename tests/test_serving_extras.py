@@ -378,3 +378,25 @@ def test_watchdog_wired_into_health_rpc():
             assert resp.live and not resp.ready
     finally:
         mgr.shutdown()
+
+
+def test_stream_infer_with_batching_enabled():
+    """Regression: StreamInfer handlers block on batch futures — the batched
+    runner's window launches must not share their worker pool (deadlock)."""
+    from tpulab.rpc.infer_service import (RemoteInferenceManager,
+                                          StreamInferClient)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=2)
+    mgr.register_model("mnist", make_mnist(max_batch_size=8))
+    mgr.update_resources()
+    mgr.serve(port=0, batching=True, batch_window_s=0.01)
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    client = StreamInferClient(remote, "mnist")
+    try:
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        futs = [client.submit(Input3=x) for _ in range(8)]
+        outs = [f.result(timeout=60) for f in futs]
+        assert all(o["Plus214_Output_0"].shape == (1, 10) for o in outs)
+    finally:
+        client.close()
+        remote.close()
+        mgr.shutdown()

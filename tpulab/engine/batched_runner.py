@@ -43,11 +43,14 @@ class BatchedInferRunner:
                  window_s: float = 0.002,
                  max_batch_size: Optional[int] = None):
         model = manager.model(model_name)
+        # window launches get a DEDICATED pool: sharing the manager's "pre"
+        # pool deadlocks when callers (e.g. StreamInfer handlers) block on
+        # batch futures from those same workers
         self._init(inner=manager.infer_runner(model_name),
                    input_names=[s.name for s in model.inputs],
                    window_s=window_s,
                    max_batch_size=max_batch_size or model.max_batch_size,
-                   launch_workers=manager.workers("pre"))
+                   launch_workers=None)
         self.model = model
         self.model_name = model_name
 
