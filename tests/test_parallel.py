@@ -247,3 +247,23 @@ def test_pipeline_single_microbatch():
     x = jnp.ones((1, 2, d), jnp.float32)
     out = pipeline(shard(stack_stage_params(stage_params)), x)
     np.testing.assert_allclose(np.asarray(out), 2.0)  # 1*1*2
+
+
+def test_moe_tied_logits_exact_k():
+    """Uniform router logits (padding tokens) still select exactly k."""
+    from tpulab.parallel.moe import init_moe_params, _gates
+    params = init_moe_params(d_model=16, d_ff=32, n_experts=4, seed=0)
+    zeros = jnp.zeros((3, 16), jnp.float32)   # tied logits everywhere
+    g1 = _gates(params, zeros, top_k=1)
+    assert ((np.asarray(g1) > 0).sum(-1) == 1).all()
+    g2 = _gates(params, zeros, top_k=2)
+    assert ((np.asarray(g2) > 0).sum(-1) == 2).all()
+
+
+def test_pipeline_rejects_stage_mesh_mismatch():
+    from tpulab.parallel.pipeline import make_pipeline, stack_stage_params
+    mesh = make_mesh({"pp": 2})
+    stages = [{"w": jnp.eye(8)} for _ in range(4)]  # 4 stages, pp=2
+    _pipeline, shard = make_pipeline(mesh, lambda p, x: x, axis_name="pp")
+    with pytest.raises(ValueError, match="pipeline axis"):
+        shard(stack_stage_params(stages))

@@ -14,6 +14,11 @@ first-class here because multi-chip scaling shapes the core design:
   reference's cyclic windowed streaming)
 - :mod:`training` — sharded train step (dp batch + tp params) used by the
   multi-chip dry run
+- :mod:`moe` — mixture-of-experts FFN + expert parallelism (experts sharded,
+  psum combine)
+- :mod:`pipeline` — GPipe-style pipeline parallelism (microbatch streaming
+  over ppermute)
+- :mod:`multihost` — jax.distributed bootstrap, global meshes, barriers
 """
 
 from tpulab.parallel.mesh import make_mesh, default_mesh

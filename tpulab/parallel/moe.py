@@ -13,12 +13,10 @@ Router: top-k softmax gating, renormalized over the selected experts.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -34,13 +32,17 @@ def init_moe_params(d_model: int = 64, d_ff: int = 128, n_experts: int = 8,
 
 
 def _gates(params, x, top_k: int):
-    """(N, D) tokens -> (N, E) gate weights (top-k renormalized softmax)."""
+    """(N, D) tokens -> (N, E) gate weights: softmax over exactly the top-k
+    router logits (lax.top_k breaks ties deterministically — tied/uniform
+    logits still activate exactly k experts)."""
     logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
-    if top_k < probs.shape[-1]:
-        kth = jnp.sort(probs, axis=-1)[:, -top_k][:, None]
-        probs = jnp.where(probs >= kth, probs, 0.0)
-    return probs / probs.sum(axis=-1, keepdims=True)
+    n_experts = logits.shape[-1]
+    if top_k >= n_experts:
+        return jax.nn.softmax(logits, axis=-1)
+    vals, idx = jax.lax.top_k(logits, top_k)            # (N, k)
+    weights = jax.nn.softmax(vals, axis=-1)             # renormalized over k
+    onehot = jax.nn.one_hot(idx, n_experts, dtype=weights.dtype)  # (N, k, E)
+    return jnp.einsum("nk,nke->ne", weights, onehot)
 
 
 def moe_ffn(params: Dict[str, Any], x: jnp.ndarray, top_k: int = 2,

@@ -13,11 +13,10 @@ width trunk of a transformer fits; embedding/head live outside the pipeline.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -33,6 +32,11 @@ def make_pipeline(mesh: Mesh, stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray
     param_spec = P(axis_name)
 
     def shard_params(stacked_params):
+        for leaf in jax.tree_util.tree_leaves(stacked_params):
+            if leaf.shape[0] != n_stages:
+                raise ValueError(
+                    f"stacked stage dim {leaf.shape[0]} != pipeline axis "
+                    f"{axis_name}={n_stages} (one stage per device)")
         return jax.device_put(stacked_params, jax.tree_util.tree_map(
             lambda _: NamedSharding(mesh, param_spec), stacked_params))
 
