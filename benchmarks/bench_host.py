@@ -114,6 +114,28 @@ def bench_staging_carve():
     print(f"{'Bindings carve+reset (mnist b=8)':40s} {timer(op, 2000, 100):10.0f} ns/op")
 
 
+def bench_continuous_batching():
+    """Generation throughput (CPU): continuous batching over a tiny LM."""
+    import jax.numpy as jnp
+    import numpy as _np
+    from tpulab.engine.paged import ContinuousBatcher
+    from tpulab.models.transformer import init_transformer_params
+    params = init_transformer_params(vocab=256, d_model=64, n_heads=4,
+                                     n_layers=2, d_ff=128)
+    cb = ContinuousBatcher(params, n_heads=4, n_layers=2, lanes=4,
+                           max_len=64, page_size=8,
+                           compute_dtype=jnp.float32)
+    rng = _np.random.default_rng(0)
+    t0 = time.perf_counter()
+    futs = [cb.submit(rng.integers(0, 256, (8,), _np.int32), 16)
+            for _ in range(16)]
+    total = sum(len(f.result(timeout=300)) for f in futs)
+    dt = time.perf_counter() - t0
+    print(f"{'continuous batching (4 lanes, tiny LM)':40s} "
+          f"{total / dt:10.0f} tok/s")
+    cb.shutdown()
+
+
 if __name__ == "__main__":
     from tpulab.tpu.platform import force_cpu
     force_cpu(1)  # host benchmarks must not depend on device availability
@@ -126,3 +148,4 @@ if __name__ == "__main__":
     bench_batcher()
     bench_dispatcher_engine()
     bench_staging_carve()
+    bench_continuous_batching()

@@ -267,3 +267,23 @@ def test_pipeline_rejects_stage_mesh_mismatch():
     _pipeline, shard = make_pipeline(mesh, lambda p, x: x, axis_name="pp")
     with pytest.raises(ValueError, match="pipeline axis"):
         shard(stack_stage_params(stages))
+
+
+def test_moe_transformer_serves():
+    """MoE transformer registers and serves through the engine."""
+    from tpulab.engine import InferenceManager
+    from tpulab.models.transformer import make_moe_transformer
+    model = make_moe_transformer(vocab=64, d_model=32, n_heads=2,
+                                 n_layers=2, d_ff=64, n_experts=4,
+                                 seq_len=16, max_batch_size=2,
+                                 compute_dtype=jnp.float32)
+    mgr = InferenceManager(max_executions=1)
+    mgr.register_model("moe", model)
+    mgr.update_resources()
+    try:
+        toks = np.random.default_rng(0).integers(0, 64, (1, 16), np.int32)
+        out = mgr.infer_runner("moe").infer(tokens=toks).result(timeout=120)
+        assert out["logits"].shape == (1, 16, 64)
+        assert np.isfinite(out["logits"]).all()
+    finally:
+        mgr.shutdown()

@@ -236,3 +236,67 @@ def transformer_forward_collect_kv(params: Dict[str, Any],
     :func:`transformer_apply` (single source of truth)."""
     return _forward(params, tokens, n_heads, n_layers, compute_dtype,
                     attention_fn, collect_kv=True)
+
+
+def make_moe_transformer(vocab: int = 32000, d_model: int = 512,
+                         n_heads: int = 8, n_layers: int = 6,
+                         d_ff: int = 2048, n_experts: int = 8,
+                         top_k: int = 2, seq_len: int = 1024,
+                         max_batch_size: int = 4,
+                         compute_dtype=jnp.bfloat16, seed: int = 0,
+                         attention_fn: Callable = causal_attention):
+    """Transformer with MoE FFN blocks (per-layer expert banks; dense
+    compute here, expert-parallel execution via
+    tpulab.parallel.moe.make_expert_parallel_ffn over the same params)."""
+    from tpulab.engine.model import IOSpec, Model
+    from tpulab.parallel.moe import init_moe_params, moe_ffn
+
+    rng = jax.random.PRNGKey(seed)
+    keys = iter(jax.random.split(rng, 2 * n_layers + 2))
+    s = 0.02
+    params: Dict[str, Any] = {
+        "embed": jax.random.normal(next(keys), (vocab, d_model)) * s,
+        "final_norm": {"scale": jnp.ones((d_model,))},
+    }
+    for i in range(n_layers):
+        params[f"layer{i}"] = {
+            "ln1": {"scale": jnp.ones((d_model,))},
+            "ln2": {"scale": jnp.ones((d_model,))},
+            "wqkv": jax.random.normal(next(keys), (d_model, 3 * d_model)) * s,
+            "wo": jax.random.normal(next(keys), (d_model, d_model)) * s,
+            "moe": init_moe_params(d_model, d_ff, n_experts,
+                                   seed=seed + i + 1),
+        }
+
+    def apply_fn(p, inputs):
+        tokens = inputs["tokens"]
+        emb = p["embed"].astype(compute_dtype)
+        x = emb[tokens]
+        b, t, dm = x.shape
+        head_dim = dm // n_heads
+        for i in range(n_layers):
+            lp = p[f"layer{i}"]
+            h = _rmsnorm(x, lp["ln1"]["scale"])
+            qkv = h @ lp["wqkv"].astype(compute_dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, t, n_heads, head_dim)
+            k = k.reshape(b, t, n_heads, head_dim)
+            v = v.reshape(b, t, n_heads, head_dim)
+            attn = attention_fn(q, k, v).reshape(b, t, dm)
+            x = x + attn @ lp["wo"].astype(compute_dtype)
+            h = _rmsnorm(x, lp["ln2"]["scale"])
+            ff = moe_ffn(lp["moe"], h.reshape(b * t, dm), top_k=top_k,
+                         compute_dtype=compute_dtype).reshape(b, t, dm)
+            x = x + ff.astype(x.dtype)
+        x = _rmsnorm(x, p["final_norm"]["scale"])
+        logits = x.astype(jnp.float32) @ p["embed"].T.astype(jnp.float32)
+        return {"logits": logits}
+
+    return Model(
+        name="moe_transformer",
+        apply_fn=apply_fn,
+        params=params,
+        inputs=[IOSpec("tokens", (seq_len,), np.int32)],
+        outputs=[IOSpec("logits", (seq_len, vocab), np.float32)],
+        max_batch_size=max_batch_size,
+    )
