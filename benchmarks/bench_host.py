@@ -126,6 +126,8 @@ def bench_continuous_batching():
                            max_len=64, page_size=8,
                            compute_dtype=jnp.float32)
     rng = _np.random.default_rng(0)
+    # warmup: compile prefill bucket + decode step before timing
+    cb.submit(rng.integers(0, 256, (8,), _np.int32), 4).result(timeout=300)
     t0 = time.perf_counter()
     futs = [cb.submit(rng.integers(0, 256, (8,), _np.int32), 16)
             for _ in range(16)]

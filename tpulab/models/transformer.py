@@ -60,9 +60,16 @@ def causal_attention(q, k, v):
     return dense_attention(q, k, v, causal=True)
 
 
+def _dense_ffn(p, h, compute_dtype):
+    """Default FFN block (w1/gelu/w2)."""
+    return jax.nn.gelu(h @ p["w1"].astype(compute_dtype)) \
+        @ p["w2"].astype(compute_dtype)
+
+
 def _forward(params, tokens, n_heads, n_layers, compute_dtype, attention_fn,
-             collect_kv: bool = False):
-    """Shared transformer trunk: (B, T) tokens -> (logits, kvs or None)."""
+             collect_kv: bool = False, ffn_fn=_dense_ffn):
+    """Shared transformer trunk: (B, T) tokens -> (logits, kvs or None).
+    ``ffn_fn(layer_params, h, compute_dtype)`` swaps the FFN (dense / MoE)."""
     emb = params["embed"].astype(compute_dtype)
     x = emb[tokens]
     b, t, d_model = x.shape
@@ -81,8 +88,7 @@ def _forward(params, tokens, n_heads, n_layers, compute_dtype, attention_fn,
         attn = attention_fn(q, k, v).reshape(b, t, d_model)
         x = x + attn @ p["wo"].astype(compute_dtype)
         h = _rmsnorm(x, p["ln2"]["scale"])
-        ff = jax.nn.gelu(h @ p["w1"].astype(compute_dtype))
-        x = x + ff @ p["w2"].astype(compute_dtype)
+        x = x + ffn_fn(p, h, compute_dtype).astype(x.dtype)
     x = _rmsnorm(x, params["final_norm"]["scale"])
     logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
     return logits, kvs
@@ -268,28 +274,14 @@ def make_moe_transformer(vocab: int = 32000, d_model: int = 512,
                                    seed=seed + i + 1),
         }
 
+    def moe_block(lp, h, cdtype):
+        b, t, dm = h.shape
+        return moe_ffn(lp["moe"], h.reshape(b * t, dm), top_k=top_k,
+                       compute_dtype=cdtype).reshape(b, t, dm)
+
     def apply_fn(p, inputs):
-        tokens = inputs["tokens"]
-        emb = p["embed"].astype(compute_dtype)
-        x = emb[tokens]
-        b, t, dm = x.shape
-        head_dim = dm // n_heads
-        for i in range(n_layers):
-            lp = p[f"layer{i}"]
-            h = _rmsnorm(x, lp["ln1"]["scale"])
-            qkv = h @ lp["wqkv"].astype(compute_dtype)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            q = q.reshape(b, t, n_heads, head_dim)
-            k = k.reshape(b, t, n_heads, head_dim)
-            v = v.reshape(b, t, n_heads, head_dim)
-            attn = attention_fn(q, k, v).reshape(b, t, dm)
-            x = x + attn @ lp["wo"].astype(compute_dtype)
-            h = _rmsnorm(x, lp["ln2"]["scale"])
-            ff = moe_ffn(lp["moe"], h.reshape(b * t, dm), top_k=top_k,
-                         compute_dtype=compute_dtype).reshape(b, t, dm)
-            x = x + ff.astype(x.dtype)
-        x = _rmsnorm(x, p["final_norm"]["scale"])
-        logits = x.astype(jnp.float32) @ p["embed"].T.astype(jnp.float32)
+        logits, _ = _forward(p, inputs["tokens"], n_heads, n_layers,
+                             compute_dtype, attention_fn, ffn_fn=moe_block)
         return {"logits": logits}
 
     return Model(
