@@ -189,3 +189,26 @@ def test_benchmark_workspace_run():
     ws.synchronize()
     ws.async_d2h()
     assert np.isfinite(ws.host_outputs["Plus214_Output_0"]).all()
+
+
+def test_transfer_engine_put_coalesced():
+    import jax
+    import jax.numpy as jnp
+    from tpulab.tpu.transfer import TransferEngine
+    eng = TransferEngine()
+    try:
+        dev = jax.devices()[0]
+        trees = [{"x": np.full((4,), i, np.float32)} for i in range(6)]
+        futs = [eng.put(t, dev) for t in trees]
+        outs = [f.result(timeout=30) for f in futs]
+        for i, out in enumerate(outs):
+            assert out["x"].devices() == {dev}
+            np.testing.assert_array_equal(np.asarray(out["x"]),
+                                          np.full((4,), i, np.float32))
+        # mixed puts + fetches in one engine
+        pf = eng.put({"y": np.ones(3, np.float32)}, dev)
+        ff = eng.fetch({"z": jnp.full((2,), 9.0)})
+        assert pf.result(timeout=30)["y"].devices() == {dev}
+        assert ff.result(timeout=30)["z"][0] == 9.0
+    finally:
+        eng.shutdown()

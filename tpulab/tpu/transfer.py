@@ -69,7 +69,19 @@ class TransferEngine:
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("TransferEngine is shut down")
-            self._queue.append((tree, fut))
+            self._queue.append(("fetch", tree, None, fut))
+            self._cv.notify()
+        return fut
+
+    def put(self, tree: Any, device=None) -> Future:
+        """Coalesced host->device: pending puts ship in ONE jax.device_put
+        call per cycle (relayed clients pay one round trip, not N).  The
+        future resolves to the device tree."""
+        fut: Future = Future()
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("TransferEngine is shut down")
+            self._queue.append(("put", tree, device, fut))
             self._cv.notify()
         return fut
 
@@ -97,8 +109,15 @@ class TransferEngine:
                     self._cv.wait()
                 if self._shutdown and not self._queue:
                     return
-                cycle: List[Tuple[Any, Future]] = list(self._queue)
+                entries = list(self._queue)
                 self._queue.clear()
+            fetches = [(t, f) for kind, t, _d, f in entries if kind == "fetch"]
+            puts = [(t, d, f) for kind, t, d, f in entries if kind == "put"]
+            if puts:
+                self._process_puts(jax, puts)
+            if not fetches:
+                continue
+            cycle = fetches
             try:
                 self._process_cycle(jax, cycle)
             except Exception:  # pragma: no cover - never kill the collector
@@ -110,6 +129,29 @@ class TransferEngine:
                         fut.set_result(jax.tree_util.tree_map(np.asarray, tree))
                     except BaseException as e:  # noqa: BLE001
                         fut.set_exception(e)
+
+    def _process_puts(self, jax, puts) -> None:
+        """One jax.device_put per (device, cycle): ships every pending host
+        tree together."""
+        by_device: Dict[Any, List] = {}
+        for tree, device, fut in puts:
+            by_device.setdefault(device, []).append((tree, fut))
+        for device, group in by_device.items():
+            try:
+                shipped = jax.device_put([t for t, _f in group], device)
+            except Exception:
+                # fall back per-item so one bad tree doesn't sink the group
+                for tree, fut in group:
+                    if fut.done():
+                        continue
+                    try:
+                        fut.set_result(jax.device_put(tree, device))
+                    except BaseException as e:  # noqa: BLE001
+                        fut.set_exception(e)
+                continue
+            for dev_tree, (_t, fut) in zip(shipped, group):
+                if not fut.done():
+                    fut.set_result(dev_tree)
 
     def _process_cycle(self, jax, cycle: List[Tuple[Any, Future]]) -> None:
         # Flatten every pending tree; group leaves by (shape, dtype).
