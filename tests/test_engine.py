@@ -234,3 +234,23 @@ def test_failed_dispatch_does_not_strand_token():
         assert out["Plus214_Output_0"].shape == (1, 10)
     finally:
         mgr.shutdown()
+
+
+def test_coalesced_h2d_serving_path():
+    """coalesce_h2d=True: inputs ride the TransferEngine's batched put;
+    results match the direct path."""
+    mgr = InferenceManager(max_executions=2, coalesce_h2d=True)
+    mgr.register_model("mnist", make_mnist(max_batch_size=2))
+    mgr.update_resources()
+    try:
+        runner = mgr.infer_runner("mnist")
+        x = np.random.default_rng(6).standard_normal((2, 28, 28, 1)).astype(np.float32)
+        futs = [runner.infer(Input3=x) for _ in range(8)]
+        outs = [f.result(timeout=60) for f in futs]
+        direct = mgr.compiled("mnist")(2, {"Input3": x})["Plus214_Output_0"]
+        for o in outs:
+            np.testing.assert_allclose(o["Plus214_Output_0"],
+                                       np.asarray(direct), rtol=1e-4,
+                                       atol=1e-5)
+    finally:
+        mgr.shutdown()

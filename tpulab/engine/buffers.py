@@ -32,7 +32,7 @@ class Buffers:
     """One pool slot of staging memory (reference FixedBuffers)."""
 
     def __init__(self, host_stack_bytes: int, device=None, block_size: int = 0,
-                 transfer_engine=None):
+                 transfer_engine=None, coalesce_h2d: bool = False):
         block = block_size or host_stack_bytes
         self._arena = BlockArena(
             FixedSizeBlockAllocator(make_staging_allocator(), block),
@@ -40,6 +40,7 @@ class Buffers:
         self._stack = BlockStack(self._arena)
         self.device = device
         self.transfer_engine = transfer_engine
+        self.coalesce_h2d = coalesce_h2d
 
     def create_bindings(self, model: Model, batch_size: int) -> "Bindings":
         """Carve per-binding staging views (reference CreateBindings)."""
@@ -109,7 +110,15 @@ class Bindings:
 
     # -- transfers ----------------------------------------------------------
     def copy_to_device(self) -> None:
-        """Async H2D of every input binding (reference CopyToDevice)."""
+        """H2D of every input binding (reference CopyToDevice).  With the
+        manager's coalesce_h2d flag the bindings ride the TransferEngine's
+        batched put (one device_put per cycle across concurrent requests);
+        otherwise each binding dispatches its own async put."""
+        engine = self._buffers.transfer_engine
+        if engine is not None and getattr(self._buffers, "coalesce_h2d", False):
+            self.device_inputs = engine.put(
+                dict(self.host_inputs), self.device).result()
+            return
         for name, host in self.host_inputs.items():
             self.device_inputs[name] = copy_to_device(host, self.device)
 

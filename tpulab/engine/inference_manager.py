@@ -35,11 +35,12 @@ class InferenceManager:
     """Pools + models + thread pools (reference InferenceManager)."""
 
     def __init__(self, max_executions: int = 2, max_buffers: int = 0,
-                 device=None):
+                 device=None, coalesce_h2d: bool = False):
         if max_executions < 1:
             raise ValueError("max_executions must be >= 1")
         self.max_executions = max_executions
         self.max_buffers = max_buffers or 2 * max_executions  # reference :59-62
+        self.coalesce_h2d = coalesce_h2d  # batched input puts (relay-friendly)
         self.device = device if device is not None else plat.local_device(0)
         self._runtime = Runtime(self.device)
         self._models: Dict[str, Model] = {}
@@ -101,7 +102,8 @@ class InferenceManager:
         self._event_poller = EventPoller()
         self._buffers_pool = Pool(
             (Buffers(stack_bytes, self.device,
-                     transfer_engine=self._transfer_engine)
+                     transfer_engine=self._transfer_engine,
+                     coalesce_h2d=self.coalesce_h2d)
              for _ in range(self.max_buffers)),
             on_return=Buffers.reset)
         self._exec_tokens = Pool(range(self.max_executions))
