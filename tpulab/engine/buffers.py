@@ -115,7 +115,10 @@ class Bindings:
         batched put (one device_put per cycle across concurrent requests);
         otherwise each binding dispatches its own async put."""
         engine = self._buffers.transfer_engine
-        if engine is not None and getattr(self._buffers, "coalesce_h2d", False):
+        if engine is not None and self._buffers.coalesce_h2d:
+            # blocks this dispatch thread until the collector's next cycle;
+            # the manager sizes the dispatch pool up under coalesce_h2d so
+            # a full cycle's worth of requests can coalesce
             self.device_inputs = engine.put(
                 dict(self.host_inputs), self.device).result()
             return

@@ -107,9 +107,13 @@ class InferenceManager:
              for _ in range(self.max_buffers)),
             on_return=Buffers.reset)
         self._exec_tokens = Pool(range(self.max_executions))
-        for name in ("pre", "dispatch", "post"):
+        # coalesced H2D parks dispatch threads on put futures — give the
+        # stage enough threads that a full transfer cycle can coalesce
+        dispatch_threads = max(2, self.max_buffers) if self.coalesce_h2d else 2
+        for name, n in (("pre", 2), ("dispatch", dispatch_threads),
+                        ("post", 2)):
             if name not in self._thread_pools:
-                self._thread_pools[name] = ThreadPool(2, name=name)
+                self._thread_pools[name] = ThreadPool(n, name=name)
         self._allocated = True
         log.info("resources: %d buffer bundles x %dB, %d exec tokens",
                  self.max_buffers, stack_bytes, self.max_executions)

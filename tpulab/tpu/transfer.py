@@ -54,7 +54,8 @@ class TransferEngine:
         if mode not in ("direct", "stack"):
             raise ValueError(f"unknown transfer mode {mode!r}")
         self.mode = mode
-        self._queue: Deque[Tuple[Any, Future]] = collections.deque()
+        #: entries: (kind "fetch"|"put", tree, device-or-None, future)
+        self._queue: Deque[Tuple[str, Any, Any, Future]] = collections.deque()
         self._cv = threading.Condition()
         self._shutdown = False
         self._stack_fn = None  # lazily built jitted stack
@@ -114,7 +115,10 @@ class TransferEngine:
             fetches = [(t, f) for kind, t, _d, f in entries if kind == "fetch"]
             puts = [(t, d, f) for kind, t, d, f in entries if kind == "put"]
             if puts:
-                self._process_puts(jax, puts)
+                try:
+                    self._process_puts(jax, puts)
+                except Exception:  # pragma: no cover - collector must live
+                    log.exception("put cycle failed")
             if not fetches:
                 continue
             cycle = fetches
@@ -129,6 +133,17 @@ class TransferEngine:
                         fut.set_result(jax.tree_util.tree_map(np.asarray, tree))
                     except BaseException as e:  # noqa: BLE001
                         fut.set_exception(e)
+
+    @staticmethod
+    def _settle(fut: Future, value=None, exc=None) -> None:
+        """Resolve a future tolerating concurrent cancellation."""
+        try:
+            if exc is not None:
+                fut.set_exception(exc)
+            elif not fut.done():
+                fut.set_result(value)
+        except Exception:  # InvalidStateError on racing cancel — drop
+            pass
 
     def _process_puts(self, jax, puts) -> None:
         """One jax.device_put per (device, cycle): ships every pending host
@@ -145,13 +160,12 @@ class TransferEngine:
                     if fut.done():
                         continue
                     try:
-                        fut.set_result(jax.device_put(tree, device))
+                        self._settle(fut, jax.device_put(tree, device))
                     except BaseException as e:  # noqa: BLE001
-                        fut.set_exception(e)
+                        self._settle(fut, exc=e)
                 continue
             for dev_tree, (_t, fut) in zip(shipped, group):
-                if not fut.done():
-                    fut.set_result(dev_tree)
+                self._settle(fut, dev_tree)
 
     def _process_cycle(self, jax, cycle: List[Tuple[Any, Future]]) -> None:
         # Flatten every pending tree; group leaves by (shape, dtype).
