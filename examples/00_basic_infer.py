@@ -26,6 +26,8 @@ def main():
     ap.add_argument("--latency", action="store_true",
                     help="also report p50/p90/p99")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
+    ap.add_argument("--coalesce-h2d", action="store_true",
+                    help="batch input puts through the transfer engine")
     args = ap.parse_args()
 
     if args.cpu:
@@ -43,7 +45,8 @@ def main():
     model = build_model(args.model, **kwargs)
 
     mgr = InferenceManager(max_executions=args.contexts,
-                           max_buffers=args.buffers)
+                           max_buffers=args.buffers,
+                           coalesce_h2d=args.coalesce_h2d)
     mgr.register_model(args.model, model)
     mgr.update_resources()
 

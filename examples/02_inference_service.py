@@ -22,6 +22,7 @@ def main():
     ap.add_argument("--batch-window-ms", type=float, default=5.0)
     ap.add_argument("--uint8", action="store_true")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--coalesce-h2d", action="store_true")
     args = ap.parse_args()
 
     if args.cpu:
@@ -42,7 +43,8 @@ def main():
     metrics = InferenceMetrics()
     start_metrics_server(metrics, args.metrics_port)
 
-    mgr = tpulab.InferenceManager(max_exec_concurrency=args.contexts)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=args.contexts,
+                                  coalesce_h2d=args.coalesce_h2d)
     mgr.register_model(args.model, model)
     mgr.update_resources()
     mgr.serve(port=args.port, batching=args.batching,
