@@ -20,3 +20,17 @@ def test_annotate_runs():
     import jax.numpy as jnp
     with annotate("test-region"):
         (jnp.ones((8, 8)) * 2).block_until_ready()
+
+
+def test_profiler_trace_capture(tmp_path):
+    import os
+    import jax.numpy as jnp
+    from tpulab.utils.tracing import trace
+    log_dir = str(tmp_path / "trace")
+    with trace(log_dir):
+        (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
+    # a plugins/profile capture directory must exist with content
+    found = []
+    for root, _dirs, files in os.walk(log_dir):
+        found.extend(files)
+    assert found, "profiler produced no trace files"
