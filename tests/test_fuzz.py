@@ -1,0 +1,99 @@
+"""Randomized concurrency fuzz over the serving-core primitives: invariants
+must hold under arbitrary interleavings (bounded runtime for CI)."""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+
+def test_pool_fuzz_conservation():
+    """Resources are never lost or duplicated under random pop/release/
+    detach/timeout traffic."""
+    from tpulab.core.pool import Pool
+    pool = Pool(range(6))
+    detached = []
+    lock = threading.Lock()
+    errors = []
+
+    def worker(seed):
+        rng = random.Random(seed)
+        try:
+            for _ in range(300):
+                op = rng.random()
+                try:
+                    item = pool.pop(timeout=0.5)
+                except TimeoutError:
+                    continue
+                if op < 0.05 and len(detached) < 2:
+                    with lock:
+                        if len(detached) < 2:
+                            detached.append(item.detach())
+                            continue
+                    item.release()
+                elif op < 0.5:
+                    item.release()
+                else:
+                    del item  # GC-return path
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
+    [t.start() for t in threads]
+    [t.join(timeout=120) for t in threads]
+    assert not errors
+    import gc
+    gc.collect()
+    # conservation: pool items + detached == original 6
+    deadline = 50
+    while pool.available + len(detached) < 6 and deadline:
+        gc.collect()
+        import time
+        time.sleep(0.1)
+        deadline -= 1
+    assert pool.available + len(detached) == 6
+    got = sorted(detached + [pool.pop(timeout=1).detach()
+                             for _ in range(pool.available)])
+    assert got == sorted(set(got))  # no duplication
+
+
+def test_batched_runner_fuzz_row_integrity():
+    """Random request sizes through the aggregator: every caller gets back
+    exactly its own rows."""
+    from tpulab.engine import InferenceManager
+    from tpulab.engine.batched_runner import BatchedInferRunner
+    from tpulab.models.mnist import make_mnist
+
+    mgr = InferenceManager(max_executions=2, max_buffers=6)
+    mgr.register_model("mnist", make_mnist(max_batch_size=8))
+    mgr.update_resources()
+    runner = BatchedInferRunner(mgr, "mnist", window_s=0.005)
+    direct = mgr.infer_runner("mnist")
+    errors = []
+
+    def worker(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(10):
+                n = int(rng.integers(1, 6))
+                # tag each row with a distinctive constant input
+                x = np.full((n, 28, 28, 1), float(seed) + 0.01 * n,
+                            np.float32)
+                out = runner.infer(Input3=x).result(timeout=60)
+                want = direct.infer(Input3=x).result(timeout=60)
+                np.testing.assert_allclose(out["Plus214_Output_0"],
+                                           want["Plus214_Output_0"],
+                                           rtol=1e-4, atol=1e-5)
+        except Exception as e:  # pragma: no cover
+            errors.append((seed, e))
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(6)]
+    [t.start() for t in threads]
+    [t.join(timeout=300) for t in threads]
+    try:
+        assert not errors, errors[:2]
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        runner.shutdown()
+        mgr.shutdown()
