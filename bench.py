@@ -101,6 +101,7 @@ def main() -> None:
     # over localhost, siege at depth 32 (reference 98-series measurement)
     grpc_inf_s = 0.0
     if not degraded:
+        server = remote = None
         try:
             from tpulab.rpc.infer_service import (RemoteInferenceManager,
                                                   build_infer_service)
@@ -123,13 +124,16 @@ def main() -> None:
             for f in futs:
                 f.result(timeout=300)
             grpc_inf_s = n_req / (time.perf_counter() - t0)
-            remote.close()
-            res = getattr(server, "_infer_resources", None)
-            server.shutdown()
-            if res is not None:
-                res.shutdown()
         except Exception as e:
             print(f"# serving metric skipped: {e!r}", file=sys.stderr)
+        finally:  # never leak the server into the rest of the bench
+            try:
+                if remote is not None:
+                    remote.close()
+                if server is not None:
+                    server.shutdown()  # owns attached service resources
+            except Exception as e:
+                print(f"# serving teardown: {e!r}", file=sys.stderr)
 
     headline = results[1]["inferences_per_second"]
     line = {

@@ -20,7 +20,7 @@ def test_pool_fuzz_conservation():
     def worker(seed):
         rng = random.Random(seed)
         try:
-            for _ in range(300):
+            for _ in range(100):
                 op = rng.random()
                 try:
                     item = pool.pop(timeout=0.5)
@@ -42,6 +42,7 @@ def test_pool_fuzz_conservation():
     threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
     [t.start() for t in threads]
     [t.join(timeout=120) for t in threads]
+    assert not any(t.is_alive() for t in threads), "pool fuzz worker hung"
     assert not errors
     import gc
     gc.collect()

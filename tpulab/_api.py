@@ -59,10 +59,7 @@ class InferenceManager(_EngineManager):
 
     def shutdown(self) -> None:
         if self._server is not None:
-            res = getattr(self._server, "_infer_resources", None)
-            self._server.shutdown()
-            if res is not None:
-                res.shutdown()
+            self._server.shutdown()  # owns the attached service resources
             self._server = None
         super().shutdown()
 

@@ -149,6 +149,14 @@ class Server:
         if self._worker_pool is not None:
             self._worker_pool.shutdown(wait=False)
             self._worker_pool = None
+        # attached service resources (e.g. infer_service's batched runners /
+        # generate workers) are owned by the server lifecycle
+        res = getattr(self, "_infer_resources", None)
+        if res is not None and hasattr(res, "shutdown"):
+            try:
+                res.shutdown()
+            except Exception:  # pragma: no cover
+                log.exception("service resources shutdown failed")
         self._server = None
         self._running.clear()
 
