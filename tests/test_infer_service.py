@@ -67,6 +67,20 @@ def test_remote_bad_dtype_is_clean_error(serving):
         runner.infer(Input3=bad).result(timeout=60)
 
 
+def test_remote_requested_outputs_subset_and_unknown(serving):
+    _mgr, remote = serving
+    runner = remote.infer_runner("mnist")
+    x = np.zeros((1, 28, 28, 1), np.float32)
+    out = runner.infer(requested_outputs=["Plus214_Output_0"],
+                       Input3=x).result(timeout=60)
+    assert set(out) == {"Plus214_Output_0"}
+    # a typo'd output name must be an INVALID_ARGUMENT error, not an
+    # empty SUCCESS response
+    with pytest.raises(RuntimeError, match="INVALID_ARGUMENT"):
+        runner.infer(requested_outputs=["Plus214_Output_0_typo"],
+                     Input3=x).result(timeout=60)
+
+
 def test_remote_binding_introspection(serving):
     _mgr, remote = serving
     runner = remote.infer_runner("mnist")

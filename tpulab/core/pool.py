@@ -131,7 +131,8 @@ class Pool(Generic[T]):
         return len(self._queue)
 
     def push(self, item: T) -> None:
-        self._size += 1
+        with self._waiter_lock:  # size must not drift under concurrent push
+            self._size += 1
         self._return(item, run_hook=False)
 
     def _return(self, item: T, run_hook: bool = True) -> None:

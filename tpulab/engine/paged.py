@@ -473,8 +473,12 @@ class ContinuousBatcher:
             next_tokens = np.asarray(logits.argmax(-1), np.int32)
         else:
             logits_host = np.asarray(logits)
+            # only active lanes consume PRNG state: a page-starved or
+            # pending-prefill lane must not perturb a seeded request's
+            # token sequence (per-request reproducibility)
             next_tokens = np.asarray(
-                [req.sampling.pick(logits_host[lane]) if req is not None
+                [req.sampling.pick(logits_host[lane])
+                 if req is not None and active[lane]
                  else 0 for lane, req in enumerate(snapshot)], np.int32)
 
         emits: List = []

@@ -36,6 +36,14 @@ def tensor_to_proto(name: str, array: np.ndarray) -> pb.TensorProto:
 
 
 def proto_to_tensor(t: pb.TensorProto) -> np.ndarray:
+    """Zero-copy view over the protobuf ``raw_data``.
+
+    Contract: the returned array is READ-ONLY (in-place writes raise) and
+    aliases the request message — it must not outlive request handling.
+    Runners only read it (staging-copy / device_put), so the view is safe
+    on the serving path; callers needing a writable or long-lived tensor
+    must ``.copy()`` it themselves.
+    """
     return np.frombuffer(t.raw_data, dtype=np.dtype(t.dtype)).reshape(
         tuple(t.dims))
 
@@ -148,6 +156,14 @@ class InferContext(Context):
                 if arr.shape[0] > model.max_batch_size:
                     raise ValueError(f"batch {arr.shape[0]} exceeds "
                                      f"max_batch_size {model.max_batch_size}")
+            output_names = {s.name for s in model.outputs}
+            unknown = set(request.requested_outputs) - output_names
+            if unknown:
+                # a client typo must not yield an empty SUCCESS response —
+                # and must not consume a device inference either
+                raise ValueError(
+                    f"unknown requested_outputs {sorted(unknown)}; "
+                    f"model outputs are {sorted(output_names)}")
         except Exception as e:
             resp.status.code = pb.INVALID_ARGUMENT
             resp.status.message = str(e)
@@ -575,12 +591,23 @@ class InferRemoteRunner:
         return {s.name: (tuple(s.dims), np.dtype(s.dtype))
                 for s in self.status.outputs}
 
-    def infer(self, **arrays: np.ndarray):
-        """Future of dict-of-numpy outputs."""
+    def infer(self, requested_outputs=None, **arrays: np.ndarray):
+        """Future of dict-of-numpy outputs.
+
+        ``requested_outputs`` optionally names a subset of the model's
+        outputs; unknown names fail the request with INVALID_ARGUMENT.
+        A model input that is literally named ``requested_outputs`` still
+        works: an ndarray value is rebound as an input array.
+        """
+        if isinstance(requested_outputs, np.ndarray):
+            arrays["requested_outputs"] = requested_outputs
+            requested_outputs = None
         if not arrays:
             raise ValueError("no input arrays")
         batch = next(iter(arrays.values())).shape[0]
         req = pb.InferRequest(model_name=self.model_name, batch_size=batch)
+        if requested_outputs:
+            req.requested_outputs.extend(requested_outputs)
         for name, arr in arrays.items():
             req.inputs.append(tensor_to_proto(name, arr))
 

@@ -175,7 +175,10 @@ class TransferEngine:
             leaves, treedef = jax.tree_util.tree_flatten(tree)
             flat.append((i, leaves, treedef))
             for j, leaf in enumerate(leaves):
-                if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                # only device arrays join a fetch group: a plain numpy leaf
+                # has no copy_to_host_async and would abort the whole cycle
+                # into the per-item fallback, losing coalescing
+                if hasattr(leaf, "copy_to_host_async"):
                     key = (tuple(leaf.shape), str(leaf.dtype))
                     groups.setdefault(key, []).append((i, j, leaf))
 
