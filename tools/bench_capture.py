@@ -1,0 +1,91 @@
+"""Round-long real-device bench capture loop.
+
+Round 1 recorded zero real-TPU evidence because the device wedged once and
+the round's single end-of-round bench fell back to CPU.  This tool makes the
+number un-loseable: run it in the background early in the round; it retries
+``bench.py`` with a bounded per-attempt deadline until an attempt completes
+on a real (non-degraded, non-CPU) device, then writes the parsed JSON line
+to ``docs/BENCH_EARLY_r{N}.json`` and exits.  Wedged attempts are killed by
+bench.py's own watchdog (or our outer timeout) and retried after a backoff.
+
+Usage: nohup python tools/bench_capture.py --round 2 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def attempt(deadline_s: float) -> dict | None:
+    env = dict(os.environ)
+    env["TPULAB_BENCH_DEADLINE_S"] = str(int(deadline_s - 60))
+    env.setdefault("TPULAB_BENCH_CANARY_TRIES", "2")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=deadline_s, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        print("attempt: outer timeout", flush=True)
+        return None
+    for ln in reversed(proc.stdout.strip().splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                return json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+    print(f"attempt: no JSON line (rc={proc.returncode}); stderr tail: "
+          f"{proc.stderr[-400:]}", flush=True)
+    return None
+
+
+def is_real_device(rec: dict) -> bool:
+    dev = rec.get("device", "")
+    return ("DEGRADED" not in dev and "TIMEOUT" not in dev
+            and not dev.lower().startswith("cpu")
+            and rec.get("value", 0) > 0)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=2)
+    ap.add_argument("--attempt-deadline-s", type=float, default=2100.0)
+    ap.add_argument("--backoff-s", type=float, default=600.0)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    args = ap.parse_args()
+
+    out_path = os.path.join(REPO, "docs", f"BENCH_EARLY_r{args.round:02d}.json")
+    t_end = time.monotonic() + args.max_hours * 3600.0
+    n = 0
+    while time.monotonic() < t_end:
+        n += 1
+        print(f"[bench_capture] attempt {n} at {time.strftime('%H:%M:%S')}",
+              flush=True)
+        rec = attempt(args.attempt_deadline_s)
+        if rec is not None:
+            print(f"[bench_capture] got: {json.dumps(rec)[:300]}", flush=True)
+            if is_real_device(rec):
+                rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                   time.gmtime())
+                rec["capture_attempt"] = n
+                with open(out_path, "w") as f:
+                    json.dump(rec, f, indent=2)
+                print(f"[bench_capture] REAL DEVICE NUMBER LANDED -> "
+                      f"{out_path}", flush=True)
+                return 0
+        time.sleep(args.backoff_s)
+    print("[bench_capture] gave up: no real-device number this round",
+          flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

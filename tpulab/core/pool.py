@@ -195,6 +195,132 @@ class Pool(Generic[T]):
         return PoolItem(value, self._return)
 
 
+class NativeBackedPool(Generic[T]):
+    """Pool with :class:`Pool`'s surface whose blocking queue is the native
+    futex TokenPool (cpp/src/pool.cc, bound via tpulab.native).
+
+    Pops and pushes park in C with the GIL released — on the serving hot
+    path (three pop/release pairs per request: buffers, global token, model
+    slot) this removes the Python condition-variable wakeup cost and the
+    GIL thrash between the pipeline's stage threads.  Items live in a
+    Python-side slot table; the native pool carries slot indices.
+    """
+
+    def __init__(self, items: Iterable[T] = (),
+                 on_return: Optional[Callable[[T], None]] = None):
+        from tpulab import native
+        if not native.available():
+            raise RuntimeError("native library not built "
+                               "(cmake -S cpp -B cpp/build -G Ninja)")
+        self._native = native.NativeTokenPool()
+        self._items: list = []
+        self._on_return = on_return
+        self._lock = threading.Lock()
+        for it in items:
+            self.push(it)
+
+    @property
+    def size(self) -> int:
+        """Total resources owned (in pool + checked out)."""
+        return len(self._items)
+
+    @property
+    def available(self) -> int:
+        return len(self._native)
+
+    def push(self, item: T) -> None:
+        with self._lock:
+            idx = len(self._items)
+            self._items.append(item)
+        self._native.push(idx)
+
+    def _return_idx(self, idx: int, run_hook: bool = True) -> None:
+        if run_hook and self._on_return is not None:
+            self._on_return(self._items[idx])
+        self._native.push(idx)
+
+    def _make_item(self, idx: int,
+                   extra: Optional[Callable[[T], None]]) -> PoolItem[T]:
+        value = self._items[idx]
+
+        def return_fn(v: T) -> None:
+            if extra is not None:
+                extra(v)
+            self._return_idx(idx)
+
+        return PoolItem(value, return_fn)
+
+    def pop(self, timeout: Optional[float] = None,
+            on_return: Optional[Callable[[T], None]] = None) -> PoolItem[T]:
+        """Blocking pop (futex wait in C, GIL released). MAY BLOCK."""
+        idx = self._native.pop(timeout)
+        return self._make_item(idx, on_return)
+
+    def try_pop(self) -> Optional[PoolItem[T]]:
+        idx = self._native.try_pop()
+        if idx is None:
+            return None
+        return self._make_item(idx, None)
+
+    async def pop_async(self) -> PoolItem[T]:
+        """Event-loop pop: fast path via try_pop, else the blocking native
+        pop rides the default executor (the loop thread never blocks).
+
+        A dedicated daemon thread polls the native pop with a bounded
+        timeout (clean interpreter exit) and hands any won index to the
+        loop explicitly — a cancelled waiter's index is re-returned to the
+        pool, never leaked (Pool._deliver's guarantee; asyncio's
+        run_in_executor would silently drop the result of a cancelled
+        wrapper future, so it cannot be used here)."""
+        import asyncio
+        idx = self._native.try_pop()
+        if idx is None:
+            loop = asyncio.get_running_loop()
+            afut: "asyncio.Future[int]" = loop.create_future()
+
+            def deliver(idx2: int) -> None:  # runs on the loop
+                if afut.done():  # cancelled meanwhile: back to the pool
+                    self._return_idx(idx2, run_hook=False)
+                else:
+                    afut.set_result(idx2)
+
+            def worker() -> None:
+                while True:
+                    try:
+                        idx2 = self._native.pop(timeout=0.5)
+                    except TimeoutError:
+                        if afut.cancelled():
+                            return  # waiter gone, nothing won
+                        continue
+                    try:
+                        loop.call_soon_threadsafe(deliver, idx2)
+                    except RuntimeError:  # loop already closed
+                        self._return_idx(idx2, run_hook=False)
+                    return
+
+            threading.Thread(target=worker, name="native-pool-wait",
+                             daemon=True).start()
+            idx = await afut
+        return self._make_item(idx, None)
+
+
+def make_serving_pool(items: Iterable[T] = (),
+                      on_return: Optional[Callable[[T], None]] = None,
+                      prefer_native: bool = True):
+    """Native futex pool when the C++ core is built, else the Python Pool.
+
+    ``TPULAB_NO_NATIVE=1`` forces the Python fallback (A/B benching).
+    """
+    if prefer_native:
+        try:
+            from tpulab import native
+            if native.enabled():
+                return NativeBackedPool(items, on_return)
+        except Exception:  # pragma: no cover - fall back on any load issue
+            pass
+    return Pool(items, on_return)
+
+
 class UniquePool(Pool[T]):
     """Pool whose items are exclusively owned while out
     (reference v4::UniquePool pool.h:640-775).  In Python exclusivity is by

@@ -16,10 +16,16 @@ class InferBench:
         self._mgr = manager
 
     def run(self, model_name: str, batch_size: int = 1,
-            seconds: float = 5.0, warmup: int = 8) -> Dict[str, float]:
+            seconds: float = 5.0, warmup: int = 8,
+            depth: Optional[int] = None) -> Dict[str, float]:
         """Saturate the pools for ``seconds``; returns the reference's metric
         map: batch_size, max concurrency, batches computed, walltime,
-        batches/sec, inf/sec, execution time per batch."""
+        batches/sec, inf/sec, execution time per batch.
+
+        ``depth`` caps the number of in-flight requests (pipeline depth);
+        default = the buffers pool size (full saturation).  Sweeping depth
+        is how the dispatch-overlap sweet spot is found (reference
+        --contexts/--buffers flag sweep, examples/00)."""
         runner = self._mgr.infer_runner(model_name)
         model = self._mgr.model(model_name)
         inputs = {
@@ -27,22 +33,25 @@ class InferBench:
                 s.batched_shape(batch_size)).astype(s.np_dtype)
             for s in model.inputs
         }
+        # a full pipeline of slow batches (CPU smoke runs) can legitimately
+        # take minutes to drain — scale the per-future timeout with the run
+        timeout_s = max(300.0, 60.0 * seconds)
         # warmup: compile-cache everything and fill pipelines
         for _ in range(warmup):
-            runner.infer(**inputs).result(timeout=120)
+            runner.infer(**inputs).result(timeout=timeout_s)
 
         inflight: List = []
-        max_inflight = self._mgr.max_buffers  # pipeline depth = buffers pool
+        max_inflight = depth or self._mgr.max_buffers  # pipeline depth
         batches = 0
         start = time.perf_counter()
         deadline = start + seconds
         while time.perf_counter() < deadline:
             while len(inflight) >= max_inflight:
-                inflight.pop(0).result(timeout=120)
+                inflight.pop(0).result(timeout=timeout_s)
                 batches += 1
             inflight.append(runner.infer(**inputs))
         for f in inflight:
-            f.result(timeout=120)
+            f.result(timeout=timeout_s)
             batches += 1
         walltime = time.perf_counter() - start
 

@@ -20,7 +20,7 @@ import logging
 import threading
 from typing import Any, Dict, Iterable, Optional
 
-from tpulab.core.pool import Pool, PoolItem
+from tpulab.core.pool import Pool, PoolItem, make_serving_pool
 from tpulab.core.thread_pool import ThreadPool
 from tpulab.engine.buffers import Buffers
 from tpulab.engine.execution_context import ExecutionContext
@@ -35,12 +35,16 @@ class InferenceManager:
     """Pools + models + thread pools (reference InferenceManager)."""
 
     def __init__(self, max_executions: int = 2, max_buffers: int = 0,
-                 device=None, coalesce_h2d: bool = False):
+                 device=None, coalesce_h2d: bool = True):
         if max_executions < 1:
             raise ValueError("max_executions must be >= 1")
         self.max_executions = max_executions
         self.max_buffers = max_buffers or 2 * max_executions  # reference :59-62
-        self.coalesce_h2d = coalesce_h2d  # batched input puts (relay-friendly)
+        # default-on batched input puts: concurrent requests share one
+        # jax.device_put per collector cycle (a lone put still ships
+        # immediately — the collector drains as soon as it is signaled, so
+        # depth-1 latency only pays one thread handoff)
+        self.coalesce_h2d = coalesce_h2d
         self.device = device if device is not None else plat.local_device(0)
         self._runtime = Runtime(self.device)
         self._models: Dict[str, Model] = {}
@@ -67,7 +71,7 @@ class InferenceManager:
         with self._lock:
             self._models[name] = model
             self._compiled[name] = compiled
-            self._ctx_pools[name] = Pool(
+            self._ctx_pools[name] = make_serving_pool(
                 ExecutionContext(compiled, slot_id=i) for i in range(slots))
         act = compiled.activation_size_in_bytes()
         log.info("registered %s: weights=%dB activations~%dB buckets=%s",
@@ -85,7 +89,7 @@ class InferenceManager:
         with self._lock:
             self._models[name] = compiled.model
             self._compiled[name] = compiled
-            self._ctx_pools[name] = Pool(
+            self._ctx_pools[name] = make_serving_pool(
                 ExecutionContext(compiled, slot_id=i) for i in range(slots))
 
     # -- resource allocation (reference AllocateResources :181-205) ---------
@@ -100,16 +104,21 @@ class InferenceManager:
         from tpulab.tpu.transfer import TransferEngine
         self._transfer_engine = TransferEngine()
         self._event_poller = EventPoller()
-        self._buffers_pool = Pool(
+        # serving pools ride the native futex core when built (cpp/):
+        # pool pops park in C without the GIL (reference: the C++ Pool /
+        # hybrid_mutex layer IS the reference's hot path, pool.h:454-638)
+        self._buffers_pool = make_serving_pool(
             (Buffers(stack_bytes, self.device,
                      transfer_engine=self._transfer_engine,
                      coalesce_h2d=self.coalesce_h2d)
              for _ in range(self.max_buffers)),
             on_return=Buffers.reset)
-        self._exec_tokens = Pool(range(self.max_executions))
+        self._exec_tokens = make_serving_pool(range(self.max_executions))
         # coalesced H2D parks dispatch threads on put futures — give the
         # stage enough threads that a full transfer cycle can coalesce
-        dispatch_threads = max(2, self.max_buffers) if self.coalesce_h2d else 2
+        # (capped: parked threads are cheap but not free under the GIL)
+        dispatch_threads = (min(16, max(2, self.max_buffers))
+                            if self.coalesce_h2d else 2)
         for name, n in (("pre", 2), ("dispatch", dispatch_threads),
                         ("post", 2)):
             if name not in self._thread_pools:

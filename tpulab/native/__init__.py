@@ -17,6 +17,7 @@ trtlab/core); ours lives in ``cpp/`` as ``libtpulab_native.so`` with a C API
 from __future__ import annotations
 
 import os
+import weakref
 from typing import Optional
 
 from tpulab.memory.debugging import InvalidPointer, OutOfMemory
@@ -97,6 +98,12 @@ def available() -> bool:
     return _load()
 
 
+def enabled() -> bool:
+    """Built AND not disabled via ``TPULAB_NO_NATIVE=1`` (the A/B knob the
+    engine's pool/staging selection honors)."""
+    return os.environ.get("TPULAB_NO_NATIVE") != "1" and available()
+
+
 def version() -> Optional[str]:
     if not _load():
         return None
@@ -111,6 +118,9 @@ class NativeArena:
         if not _load():
             raise RuntimeError("native library not built")
         self._h = _lib.tpl_arena_create(block_size, alignment, max_blocks)
+        # GC backstop: native memory must not outlive the Python handle
+        self._finalizer = weakref.finalize(
+            self, _lib.tpl_arena_destroy, self._h)
         self.memory_type: MemoryType = HostMemory
 
     @property
@@ -143,9 +153,17 @@ class NativeArena:
         return _lib.tpl_arena_shrink(self._h)
 
     def close(self) -> None:
-        if self._h is not None:
-            _lib.tpl_arena_destroy(self._h)
-            self._h = None
+        if self._finalizer.alive:
+            self._finalizer()
+        self._h = None
+
+
+def _destroy_with_arena(destroy_fn, handle, arena_destroy, arena_handle):
+    """Ordered teardown for allocators that own their arena: the allocator's
+    destructor returns blocks to the arena, so it must die first."""
+    destroy_fn(handle)
+    if arena_handle is not None:
+        arena_destroy(arena_handle)
 
 
 class _NativeAllocBase:
@@ -169,6 +187,17 @@ class NativeTransactionalAllocator(_NativeAllocBase):
         self._owns_arena = arena is None
         self._arena = arena or NativeArena(block_size)
         self._h = _lib.tpl_txalloc_create(self._arena._h, max_stacks)
+        # ~TransactionalAllocator returns blocks to the arena: when we own
+        # the arena, one ordered finalizer tears down both (GC finalizer
+        # order within a cycle is unspecified, so the arena's own is
+        # detached); an externally-owned arena stays alive via self._arena
+        arena_h = None
+        if self._owns_arena:
+            self._arena._finalizer.detach()
+            arena_h = self._arena._h
+        self._finalizer = weakref.finalize(
+            self, _destroy_with_arena, _lib.tpl_txalloc_destroy, self._h,
+            _lib.tpl_arena_destroy, arena_h)
 
     def allocate_node(self, size: int, alignment: int = 64) -> int:
         ptr = _lib.tpl_txalloc_allocate(self._h, size, alignment)
@@ -190,11 +219,9 @@ class NativeTransactionalAllocator(_NativeAllocBase):
         return self._arena.next_block_size - 8 - alignment
 
     def close(self) -> None:
-        if self._h is not None:
-            _lib.tpl_txalloc_destroy(self._h)
-            self._h = None
-            if self._owns_arena:
-                self._arena.close()
+        if self._finalizer.alive:
+            self._finalizer()
+        self._h = None
 
 
 class NativeBFitAllocator(_NativeAllocBase):
@@ -207,6 +234,13 @@ class NativeBFitAllocator(_NativeAllocBase):
         self._owns_arena = arena is None
         self._arena = arena or NativeArena(block_size)
         self._h = _lib.tpl_bfit_create(self._arena._h, 1)
+        arena_h = None
+        if self._owns_arena:  # see NativeTransactionalAllocator
+            self._arena._finalizer.detach()
+            arena_h = self._arena._h
+        self._finalizer = weakref.finalize(
+            self, _destroy_with_arena, _lib.tpl_bfit_destroy, self._h,
+            _lib.tpl_arena_destroy, arena_h)
 
     def allocate_node(self, size: int, alignment: int = 64) -> int:
         ptr = _lib.tpl_bfit_allocate(self._h, size, alignment)
@@ -228,11 +262,9 @@ class NativeBFitAllocator(_NativeAllocBase):
         return _lib.tpl_bfit_live(self._h)
 
     def close(self) -> None:
-        if self._h is not None:
-            _lib.tpl_bfit_destroy(self._h)
-            self._h = None
-            if self._owns_arena:
-                self._arena.close()
+        if self._finalizer.alive:
+            self._finalizer()
+        self._h = None
 
 
 class NativeTokenPool:
@@ -242,6 +274,8 @@ class NativeTokenPool:
         if not _load():
             raise RuntimeError("native library not built")
         self._h = _lib.tpl_pool_create()
+        self._finalizer = weakref.finalize(
+            self, _lib.tpl_pool_destroy, self._h)
 
     def push(self, token: int) -> None:
         _lib.tpl_pool_push(self._h, token)
@@ -263,6 +297,6 @@ class NativeTokenPool:
         return _lib.tpl_pool_size(self._h)
 
     def close(self) -> None:
-        if self._h is not None:
-            _lib.tpl_pool_destroy(self._h)
-            self._h = None
+        if self._finalizer.alive:
+            self._finalizer()
+        self._h = None
