@@ -212,3 +212,77 @@ def test_transfer_engine_put_coalesced():
         assert ff.result(timeout=30)["z"][0] == 9.0
     finally:
         eng.shutdown()
+
+
+# -- HBM accounting through the device allocator framework -------------------
+
+def test_tpu_allocator_typed_nodes_and_accounting():
+    import numpy as np
+    from tpulab.tpu.allocators import TpuRawAllocator, make_tpu_allocator
+
+    alloc = make_tpu_allocator()
+    base = alloc.bytes_in_use
+    addr, arr = alloc.allocate_array((4, 8), np.float32)
+    assert arr.shape == (4, 8)
+    assert alloc.bytes_in_use == base + 4 * 8 * 4
+    taddr, tree = alloc.allocate_tree({"w": np.zeros((2, 2), np.float32),
+                                       "b": np.zeros((2,), np.float32)})
+    assert alloc.bytes_in_use == base + 4 * 8 * 4 + (4 + 2) * 4
+    assert TpuRawAllocator.total_bytes_in_use() >= alloc.bytes_in_use
+    # donation-rotation: replace keeps the accounting slot
+    import jax.numpy as jnp
+    addr2 = alloc.replace(addr, jnp.ones((4, 8), jnp.float32))
+    assert addr2 is not None and alloc.bytes_in_use == base + 128 + 24
+    alloc.deallocate_node(addr)
+    alloc.deallocate_node(taddr)
+    assert alloc.bytes_in_use == base
+
+
+def test_compiled_model_weights_are_tracked():
+    from tpulab.engine.runtime import Runtime
+    from tpulab.models.mnist import make_mnist
+
+    rt = Runtime()
+    model = make_mnist(max_batch_size=2)
+    compiled = rt.compile_model(model)
+    assert compiled.weights_addr is not None
+    assert rt.allocator.bytes_in_use >= model.weights_size_in_bytes()
+    compiled.release_weights()
+    assert rt.allocator.bytes_in_use == 0
+
+
+def test_paged_pool_hbm_tracked_and_closed():
+    import jax.numpy as jnp
+    from tpulab.engine.paged import PagedKVPool
+
+    pool = PagedKVPool(n_pages=4, page_size=8, n_layers=2, n_heads=2,
+                       head_dim=4, dtype=jnp.float32)
+    expect = 2 * (2 * 4 * 8 * 2 * 4) * 4  # k+v * shape * itemsize
+    assert pool.hbm_bytes == expect
+    # setter keeps accounting through a rotation
+    pool.k = jnp.ones_like(pool.k)
+    assert pool.hbm_bytes == expect
+    pool.close()
+    assert pool.hbm_bytes == 0
+
+
+def test_failed_compile_does_not_leak_weights():
+    import numpy as np
+    import pytest
+    from tpulab.engine.model import IOSpec, Model
+    from tpulab.engine.runtime import Runtime
+
+    rt = Runtime()
+
+    def bad_apply(params, inputs):
+        raise ValueError("boom")
+
+    model = Model("bad", bad_apply, {"w": np.zeros((1024,), np.float32)},
+                  [IOSpec("x", (4,), np.float32)],
+                  [IOSpec("y", (4,), np.float32)], max_batch_size=1,
+                  batch_buckets=[1])
+    before = rt.allocator.bytes_in_use
+    with pytest.raises(Exception):
+        rt.compile_model(model)
+    assert rt.allocator.bytes_in_use == before, \
+        "failed compile pinned a weight copy in the allocator"

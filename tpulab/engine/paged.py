@@ -33,10 +33,11 @@ class PagedKVPool:
     """Global paged K/V storage + free-page accounting (host side)."""
 
     def __init__(self, n_pages: int, page_size: int, n_layers: int,
-                 n_heads: int, head_dim: int, dtype=None, device=None):
-        import jax
+                 n_heads: int, head_dim: int, dtype=None, device=None,
+                 allocator=None):
         import jax.numpy as jnp
         from tpulab.tpu import platform as plat
+        from tpulab.tpu.allocators import make_tpu_allocator
 
         dtype = dtype or jnp.bfloat16
         self.n_pages = n_pages
@@ -45,12 +46,41 @@ class PagedKVPool:
         self.device = device if device is not None else plat.local_device(0)
         self._shape = (n_layers, n_pages, page_size, n_heads, head_dim)
         self._dtype = dtype
-        self.k = jax.device_put(jnp.zeros(self._shape, dtype), self.device)
-        self.v = jax.device_put(jnp.zeros(self._shape, dtype), self.device)
+        # the K/V page stores are HBM blocks owned by the device allocator
+        # framework (tracked bytes; reference cuda_allocators device memory);
+        # each donated decode step rotates the buffers via replace()
+        self._alloc = allocator or make_tpu_allocator(self.device)
+        self._k_addr, self._k = self._alloc.allocate_array(self._shape, dtype)
+        self._v_addr, self._v = self._alloc.allocate_array(self._shape, dtype)
         # page 0 is RESERVED as scratch: inactive/padded lanes scatter their
         # (masked-out) K/V there, so it must never hold live data
         self._free: List[int] = list(range(1, n_pages))
         self._lock = threading.Lock()
+
+    # K/V buffers rotate through XLA donation; the setters keep the device
+    # allocator's accounting slot pointing at the live generation
+    @property
+    def k(self):
+        return self._k
+
+    @k.setter
+    def k(self, value) -> None:
+        self._k = self._alloc.replace(self._k_addr, value)
+
+    @property
+    def v(self):
+        return self._v
+
+    @v.setter
+    def v(self, value) -> None:
+        self._v = self._alloc.replace(self._v_addr, value)
+
+    @property
+    def hbm_bytes(self) -> int:
+        """Live HBM of this pool's page stores (not allocator-wide: the
+        allocator may be shared, e.g. a Runtime's)."""
+        return sum(self._alloc.node_size(a)
+                   for a in (self._k_addr, self._v_addr) if a is not None)
 
     def reset(self) -> None:
         """Re-materialize the pools (recovery after a failed donated step)."""
@@ -60,6 +90,14 @@ class PagedKVPool:
         self.v = jax.device_put(jnp.zeros(self._shape, self._dtype), self.device)
         with self._lock:
             self._free = list(range(1, self.n_pages))  # page 0 stays scratch
+
+    def close(self) -> None:
+        """Eagerly free the page stores' HBM."""
+        if self._k_addr is not None:
+            self._alloc.deallocate_node(self._k_addr)
+            self._alloc.deallocate_node(self._v_addr)
+            self._k_addr = self._v_addr = None
+            self._k = self._v = None
 
     @property
     def free_pages(self) -> int:
@@ -255,6 +293,7 @@ class ContinuousBatcher:
         self.max_pages = (max_len + page_size - 1) // page_size
         d_model = params["layer0"]["wqkv"].shape[0]
         # +1: page 0 is the reserved scratch page
+        self._owns_pool = pool is None
         self.pool = pool or PagedKVPool(
             n_pages or self.max_pages * lanes + 1, page_size, n_layers,
             n_heads, d_model // n_heads, compute_dtype, device)
@@ -317,6 +356,8 @@ class ContinuousBatcher:
             self._shutdown = True
             self._cv.notify()
         self._thread.join(timeout=30)
+        if self._owns_pool and not self._thread.is_alive():
+            self.pool.close()  # free the page stores' HBM eagerly
 
     @property
     def active_lanes(self) -> int:

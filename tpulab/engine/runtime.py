@@ -36,11 +36,22 @@ class CompiledModel:
     """Per-device compiled executables, one per batch bucket."""
 
     def __init__(self, model: Model, device, executables: Dict[int, Any],
-                 device_params: Any):
+                 device_params: Any, allocator=None, weights_addr=None):
         self.model = model
         self.device = device
         self.executables = executables      # bucket -> jax Compiled
         self.device_params = device_params  # params resident on `device`
+        #: the device allocator that placed the weights + their block addr
+        #: (reference: Model owns captured weight pointers, runtime.cc:134)
+        self.allocator = allocator
+        self.weights_addr = weights_addr
+
+    def release_weights(self) -> None:
+        """Eagerly free the weights' HBM via the owning allocator."""
+        if self.allocator is not None and self.weights_addr is not None:
+            self.allocator.deallocate_node(self.weights_addr)
+            self.weights_addr = None
+            self.device_params = None
 
     def memory_analysis(self, bucket: Optional[int] = None):
         """Activation/scratch sizing (the TRT getDeviceMemorySize analog)."""
@@ -71,16 +82,40 @@ class Runtime:
     """
 
     def __init__(self, device=None):
+        from tpulab.tpu.allocators import make_tpu_allocator
         self.device = device if device is not None else plat.local_device(0)
+        #: installed device allocator (reference CustomRuntime installing an
+        #: NvAllocator, runtime.h:81-110) — weights are captured through it
+        self.allocator = make_tpu_allocator(self.device)
 
     # -- compile ------------------------------------------------------------
     def compile_model(self, model: Model, buckets: Optional[Sequence[int]] = None,
-                      donate_params: bool = False) -> CompiledModel:
+                      donate_params: bool = False,
+                      _placed: Optional[tuple] = None) -> CompiledModel:
         """JIT-compile one executable per batch bucket (AOT, warmed)."""
         import jax
 
         buckets = sorted(buckets or model.batch_buckets)
-        device_params = jax.device_put(model.params, self.device)
+        # weight capture: the allocator records the placement so the
+        # CompiledModel owns its weight bytes (tracked HBM); ``_placed``
+        # reuses a capture already made (load_engine's fallback compile)
+        owns_placement = _placed is None
+        weights_addr, device_params = (
+            _placed if _placed is not None
+            else self.allocator.allocate_tree(model.params))
+        try:
+            return self._compile_buckets(model, buckets, weights_addr,
+                                         device_params)
+        except BaseException:
+            if owns_placement:
+                # a failed compile must not pin a weight copy in the
+                # long-lived allocator (each retry would leak a full tree)
+                self.allocator.deallocate_node(weights_addr)
+            raise
+
+    def _compile_buckets(self, model: Model, buckets, weights_addr,
+                         device_params) -> CompiledModel:
+        import jax
 
         def call(params, inputs):
             return model.apply_fn(params, inputs)
@@ -104,7 +139,9 @@ class Runtime:
             lowered = jax.jit(call).lower(pspec, dummy)
             executables[b] = lowered.compile()
             log.info("compiled %s bucket=%d", model.name, b)
-        return CompiledModel(model, self.device, executables, device_params)
+        return CompiledModel(model, self.device, executables, device_params,
+                             allocator=self.allocator,
+                             weights_addr=weights_addr)
 
     # -- engine artifacts ----------------------------------------------------
     def save_engine(self, compiled: CompiledModel, path: str) -> None:
@@ -162,7 +199,17 @@ class Runtime:
         model = Model(model_name or spec["name"], apply_fn, params,
                       inputs, outputs, spec["max_batch_size"],
                       spec["batch_buckets"])
-        device_params = jax.device_put(params, self.device)
+        weights_addr, device_params = self.allocator.allocate_tree(params)
+        try:
+            return self._load_executables(path, model, weights_addr,
+                                          device_params)
+        except BaseException:
+            self.allocator.deallocate_node(weights_addr)  # no error-path leak
+            raise
+
+    def _load_executables(self, path: str, model: Model, weights_addr,
+                          device_params) -> CompiledModel:
+        import jax  # noqa: F401  (deserialization path may touch jax)
         executables: Dict[int, Any] = {}
         for b in model.batch_buckets:
             blob_path = os.path.join(path, f"bucket_{b}.xla")
@@ -189,7 +236,10 @@ class Runtime:
             executables[b] = None
         if any(v is None for v in executables.values()):
             compiled = self.compile_model(
-                model, [b for b, v in executables.items() if v is None])
+                model, [b for b, v in executables.items() if v is None],
+                _placed=(weights_addr, device_params))
             for b, exe in compiled.executables.items():
                 executables[b] = exe
-        return CompiledModel(model, self.device, executables, device_params)
+        return CompiledModel(model, self.device, executables, device_params,
+                             allocator=self.allocator,
+                             weights_addr=weights_addr)

@@ -17,8 +17,10 @@ reference's device allocators slot under its arenas.
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
-from typing import Dict, Optional
+import weakref
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -34,9 +36,46 @@ _TPU_ADDR_BASE = 1 << 60
 _TPU_ADDR_STRIDE = 1 << 40  # 1 TiB per block — offsets stay inside the block
 
 
+#: every live device allocator, for process-wide HBM accounting
+_live_allocators: "weakref.WeakSet[TpuRawAllocator]" = weakref.WeakSet()
+
+
+def _tree_nbytes(jax, tree) -> int:
+    import math
+    return sum(np.dtype(leaf.dtype).itemsize * int(math.prod(leaf.shape))
+               for leaf in jax.tree_util.tree_leaves(tree)
+               if hasattr(leaf, "shape"))
+
+
+_log = logging.getLogger("tpulab.tpu")
+
+
+def _tree_delete(jax, tree) -> None:
+    for leaf in jax.tree_util.tree_leaves(tree):
+        delete = getattr(leaf, "delete", None)
+        if delete is not None:
+            try:
+                delete()
+            except Exception as e:
+                # expected for buffers already consumed by donation; keep
+                # any other failed HBM release visible rather than letting
+                # the accounting silently undercount live device memory
+                _log.debug("leaf delete failed (donated buffer?): %r", e)
+
+
 class TpuRawAllocator:
     """RawAllocator over HBM buffers for one device
-    (reference device_allocator binding a device id)."""
+    (reference device_allocator binding a device id).
+
+    Besides raw uint8 nodes (the RawAllocator concept), it allocates
+    *typed* HBM values the engine actually serves from — arrays
+    (:meth:`allocate_array`) and weight pytrees (:meth:`allocate_tree`,
+    the reference's ``use_weights_allocator`` capture scope,
+    runtime.cc:124-143) — and supports :meth:`replace` for buffers that
+    rotate through XLA donation (the paged KV pools).  Every live byte is
+    tracked; :meth:`total_bytes_in_use` is the process-wide figure the
+    metrics HBM gauge exports.
+    """
 
     is_stateful = True
 
@@ -47,8 +86,17 @@ class TpuRawAllocator:
         self.memory_type: MemoryType = TpuMemory
         self._lock = threading.Lock()
         self._next = itertools.count()
-        #: addr -> jax.Array (the live HBM buffer)
+        #: addr -> jax.Array or pytree (the live HBM value)
         self._buffers: Dict[int, object] = {}
+        self._sizes: Dict[int, int] = {}
+        _live_allocators.add(self)
+
+    def _register(self, value: Any, nbytes: int) -> int:
+        with self._lock:
+            addr = _TPU_ADDR_BASE + next(self._next) * _TPU_ADDR_STRIDE
+            self._buffers[addr] = value
+            self._sizes[addr] = nbytes
+        return addr
 
     def allocate_node(self, size: int, alignment: int = 0) -> int:
         if size <= 0:
@@ -59,17 +107,59 @@ class TpuRawAllocator:
                 jnp.zeros((size,), dtype=jnp.uint8), self.device)
         except Exception as e:  # surface HBM exhaustion as the framework type
             raise OutOfMemory("TpuRawAllocator", size, str(e)) from e
+        return self._register(buf, size)
+
+    def allocate_array(self, shape, dtype) -> Tuple[int, Any]:
+        """Typed HBM node: a zeroed device array owned by this allocator
+        (what the paged KV pools and pre-allocated outputs draw from)."""
+        jnp = self._jax.numpy
+        nbytes = int(np.dtype(dtype).itemsize * int(np.prod(shape)))
+        try:
+            buf = self._jax.device_put(jnp.zeros(shape, dtype), self.device)
+        except Exception as e:
+            raise OutOfMemory("TpuRawAllocator", nbytes, str(e)) from e
+        return self._register(buf, nbytes), buf
+
+    def allocate_tree(self, tree: Any) -> Tuple[int, Any]:
+        """Weight capture: ship a pytree to HBM as ONE tracked allocation
+        (reference NvAllocator weights scope — the Model owns its weight
+        pointers through the allocator that placed them)."""
+        try:
+            device_tree = self._jax.device_put(tree, self.device)
+        except Exception as e:
+            raise OutOfMemory("TpuRawAllocator",
+                              _tree_nbytes(self._jax, tree), str(e)) from e
+        return (self._register(device_tree,
+                               _tree_nbytes(self._jax, device_tree)),
+                device_tree)
+
+    def replace(self, addr: int, new_value: Any) -> Any:
+        """Swap the value at ``addr`` for its successor — the
+        donation-rotation hook: the old buffer was CONSUMED by a donated
+        XLA call (never deleted here), the new one takes over its
+        accounting slot.  The slot's byte count is recomputed from the
+        successor so accounting stays honest even if shapes change."""
+        nbytes = _tree_nbytes(self._jax, new_value)
         with self._lock:
-            addr = _TPU_ADDR_BASE + next(self._next) * _TPU_ADDR_STRIDE
-            self._buffers[addr] = buf
-        return addr
+            if addr not in self._buffers:
+                raise InvalidPointer(f"{addr!r} is not an HBM block of "
+                                     f"this allocator")
+            self._buffers[addr] = new_value
+            self._sizes[addr] = nbytes
+        return new_value
+
+    def node_size(self, addr: int) -> int:
+        """Tracked bytes of one live block (0 for unknown/freed)."""
+        with self._lock:
+            return self._sizes.get(addr, 0)
 
     def deallocate_node(self, addr: int, size: int = 0, alignment: int = 0) -> None:
         with self._lock:
             buf = self._buffers.pop(addr, None)
+            self._sizes.pop(addr, None)
         if buf is None:
             raise InvalidPointer(f"0x{addr:x} not an HBM block of this allocator")
-        buf.delete()  # eagerly free HBM rather than waiting for GC
+        _tree_delete(self._jax, buf)  # eagerly free HBM, not via GC
 
     def buffer(self, addr: int):
         """The JAX array backing a block address."""
@@ -84,6 +174,17 @@ class TpuRawAllocator:
     def live_allocations(self) -> int:
         with self._lock:
             return len(self._buffers)
+
+    @property
+    def bytes_in_use(self) -> int:
+        """Live HBM bytes owned by this allocator (size_tracker figure)."""
+        with self._lock:
+            return sum(self._sizes.values())
+
+    @staticmethod
+    def total_bytes_in_use() -> int:
+        """Process-wide framework-owned HBM (the metrics gauge source)."""
+        return sum(a.bytes_in_use for a in list(_live_allocators))
 
     def max_node_size(self) -> int:
         return _TPU_ADDR_STRIDE

@@ -84,6 +84,11 @@ class InferenceMetrics:
         self.hbm_bytes_in_use = Gauge(
             f"{ns}_hbm_bytes_in_use", "Device HBM in use (power-gauge analog)",
             registry=self.registry)
+        self.framework_hbm_bytes = Gauge(
+            f"{ns}_framework_hbm_bytes",
+            "HBM owned via the device allocator framework (weights, KV "
+            "page stores) — the size_tracker figure",
+            registry=self.registry)
         self.queue_depth = Gauge(
             f"{ns}_queue_depth", "In-flight requests (NVRPC_METRICS hook)",
             registry=self.registry)
@@ -125,6 +130,8 @@ class InferenceMetrics:
         info = DeviceInfo.memory_info(device_index)
         if info.bytes_in_use is not None:
             self.hbm_bytes_in_use.set(info.bytes_in_use)
+        from tpulab.tpu.allocators import TpuRawAllocator
+        self.framework_hbm_bytes.set(TpuRawAllocator.total_bytes_in_use())
         self.refresh_quantiles()  # scrape-freshness without hot-path sorts
 
 
