@@ -26,8 +26,10 @@ def main():
     ap.add_argument("--latency", action="store_true",
                     help="also report p50/p90/p99")
     ap.add_argument("--cpu", action="store_true", help="force CPU backend")
-    ap.add_argument("--coalesce-h2d", action="store_true",
-                    help="batch input puts through the transfer engine")
+    ap.add_argument("--no-coalesce-h2d", dest="coalesce_h2d",
+                    action="store_false", default=True,
+                    help="disable batched input puts (default: on, "
+                         "matching the engine default)")
     args = ap.parse_args()
 
     if args.cpu:

@@ -22,7 +22,9 @@ def main():
     ap.add_argument("--batch-window-ms", type=float, default=5.0)
     ap.add_argument("--uint8", action="store_true")
     ap.add_argument("--cpu", action="store_true")
-    ap.add_argument("--coalesce-h2d", action="store_true")
+    ap.add_argument("--no-coalesce-h2d", dest="coalesce_h2d",
+                    action="store_false", default=True,
+                    help="disable batched H2D puts (default: on)")
     args = ap.parse_args()
 
     if args.cpu:

@@ -16,3 +16,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 from tpulab.tpu.platform import force_cpu  # noqa: E402
 
 force_cpu(8)
+
+
+def free_port() -> int:
+    """Ephemeral localhost port (best-effort: tiny close-to-rebind window)."""
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

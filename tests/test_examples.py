@@ -94,3 +94,111 @@ def test_03_middleman_batches_to_backend():
     finally:
         mm.shutdown()
         backend.shutdown()
+
+
+def test_notebook_multiple_models():
+    """The Multiple Models walkthrough runs end to end (per-model budgets,
+    mixed traffic, one endpoint serving both)."""
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "TPULAB_FORCE_CPU": "1", "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from tpulab.tpu.platform import force_cpu; force_cpu(1);"
+         "import runpy; runpy.run_path("
+         f"'{REPO}/notebooks/multiple_models.py', run_name='__main__')"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "remote == local for both models" in out.stdout
+
+
+def test_grafana_dashboard_matches_exported_metrics():
+    """Every metric the dashboard queries must actually be exported
+    (the reference dashboard drifted from its exporter; ours must not)."""
+    import json
+    import re
+    with open(f"{REPO}/examples/deploy/grafana-dashboard.json") as f:
+        dash = json.load(f)
+    exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
+    wanted = set()
+    for e in exprs:
+        wanted.update(re.findall(r"(tpulab_[a-z0-9_]+)", e))
+    from tpulab.utils.metrics import InferenceMetrics
+    m = InferenceMetrics()
+    m.observe_request(0.01, 0.005)  # populate histogram child series
+    exported = set()
+    for metric in m.registry.collect():
+        for s in metric.samples:
+            exported.add(s.name)
+    missing = {w for w in wanted
+               if w not in exported and w.removesuffix("_bucket") + "_bucket"
+               not in exported}
+    assert not missing, f"dashboard queries unexported metrics: {missing}"
+
+
+def test_12_binary_codec_service():
+    """Codec-agnostic RPC: zero-copy binary payloads through the serde
+    hooks (reference 12_FlatBuffers)."""
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, f"{REPO}/examples/12_binary_codec.py", "--cpu"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "binary-codec serving OK" in out.stdout
+
+
+def test_06_stream_client_pipelines():
+    """Standalone streaming middleman client (reference 04_Middleman
+    middleman-client)."""
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, f"{REPO}/examples/06_stream_client.py", "--cpu",
+         "--requests", "16"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "streamed:" in out.stdout
+
+
+def test_02_inference_service_cli():
+    """The flagship serving CLI boots, serves, and exports metrics (this
+    example regressed silently in round 1 — no test drove its main())."""
+    import urllib.request
+    from tests.conftest import free_port
+    port, mport = free_port(), free_port()
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin", "HOME": "/tmp"}
+    proc = subprocess.Popen(
+        [sys.executable, f"{REPO}/examples/02_inference_service.py",
+         "--cpu", "--model", "mnist", "--max-batch-size", "2",
+         "--port", str(port), "--metrics-port", str(mport), "--batching"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    try:
+        from tpulab.rpc.infer_service import RemoteInferenceManager
+        deadline = time.time() + 240
+        remote = None
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"server died: {proc.communicate()[1][-2000:]}")
+            candidate = RemoteInferenceManager(f"localhost:{port}")
+            try:
+                candidate.get_models()
+                remote = candidate  # ready only once a call succeeded
+                break
+            except Exception:
+                candidate.close()
+                time.sleep(2)
+        assert remote is not None, "server never came up"
+        out = remote.infer_runner("mnist").infer(
+            Input3=np.zeros((1, 28, 28, 1), np.float32)).result(timeout=120)
+        assert out["Plus214_Output_0"].shape == (1, 10)
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=10).read().decode()
+        assert "tpulab_request_total" in metrics
+        remote.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)

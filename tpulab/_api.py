@@ -24,10 +24,11 @@ class InferenceManager(_EngineManager):
     """Engine manager + serve() (reference PyInferenceManager)."""
 
     def __init__(self, max_exec_concurrency: int = 2, max_buffers: int = 0,
-                 device=None):
+                 device=None, coalesce_h2d: bool = True):
         # reference kwarg name: max_exec_concurrency (infer.cc:86-96)
         super().__init__(max_executions=max_exec_concurrency,
-                         max_buffers=max_buffers, device=device)
+                         max_buffers=max_buffers, device=device,
+                         coalesce_h2d=coalesce_h2d)
         self._server = None
 
     def serve(self, port: int = 50051, wait: bool = False,
