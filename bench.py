@@ -266,6 +266,19 @@ def main() -> None:
         _record(stage_p50={k: round(float(np.median(v)), 3)
                            for k, v in stages.items()})
 
+    # paged-decode kernel row (chip only): pallas ragged kernel vs XLA
+    # gather at B=8, 2k context — the beyond-reference serving differentiator
+    if not degraded and not cpu_full:
+        try:
+            from tpulab.tpu.platform import is_tpu
+            if is_tpu():
+                _phase("paged_decode_kernel")
+                from tpulab.engine.paged import (
+                    benchmark_decode_kernel_vs_gather)
+                _record(paged_decode=benchmark_decode_kernel_vs_gather())
+        except Exception as e:
+            print(f"# paged decode row skipped: {e!r}", file=sys.stderr)
+
     # flagship serving config (examples/02 analog): gRPC + dynamic batching
     # over localhost, siege at depth 32 (reference 98-series measurement)
     _record(grpc_batched_b1_inf_s=0.0)

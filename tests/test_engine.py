@@ -58,6 +58,32 @@ def test_engine_artifact_roundtrip(tmp_path):
     assert loaded.model.batch_buckets == [1, 2]
 
 
+def test_engine_artifact_loads_without_apply_fn(tmp_path):
+    """The portable-module path: an artifact is a complete program (TRT
+    plan-file property) — it must load and serve with NO Python source."""
+    import os
+    rt = Runtime()
+    m = make_mnist(max_batch_size=2)
+    compiled = rt.compile_model(m)
+    x = np.random.default_rng(3).standard_normal((2, 28, 28, 1)).astype(np.float32)
+    want = np.asarray(compiled(2, {"Input3": x})["Plus214_Output_0"])
+    path = str(tmp_path / "portable_engine")
+    rt.save_engine(compiled, path)
+    assert os.path.exists(f"{path}/bucket_2.shlo")
+    # break the topology-specific executables to force the portable path
+    for b in (1, 2):
+        blob = f"{path}/bucket_{b}.xla"
+        if os.path.exists(blob):
+            os.remove(blob)
+    loaded = rt.load_engine(path)  # NO apply_fn
+    got = np.asarray(loaded(2, {"Input3": x})["Plus214_Output_0"])
+    np.testing.assert_allclose(want, got, rtol=1e-5)
+    # every bucket serves through its own module
+    x1 = x[:1]
+    got1 = np.asarray(loaded(1, {"Input3": x1})["Plus214_Output_0"])
+    np.testing.assert_allclose(want[:1], got1, rtol=1e-4, atol=1e-5)
+
+
 # ------------------------------------------------------ buffers/bindings ---
 def test_bindings_carve_fill_roundtrip():
     m = make_mnist(max_batch_size=4)

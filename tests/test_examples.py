@@ -202,3 +202,40 @@ def test_02_inference_service_cli():
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+def test_model_store_roundtrip(tmp_path):
+    """Deployment companion: engine artifact push/pull through the object
+    store (file backend; reference Deployment/ObjectStore flow) and a
+    source-free load of the pulled artifact."""
+    from tpulab.engine.runtime import Runtime
+    from tpulab.models.mnist import make_mnist
+    from tools.model_store import pull, push
+
+    rt = Runtime()
+    compiled = rt.compile_model(make_mnist(max_batch_size=2))
+    art = tmp_path / "art"
+    rt.save_engine(compiled, str(art))
+    store = tmp_path / "store" / "mnist-v1"
+    push(str(art), str(store))
+    dest = tmp_path / "pulled"
+    pull(str(store), str(dest))
+    loaded = rt.load_engine(str(dest))  # portable modules, no apply_fn
+    out = loaded(1, {"Input3": np.zeros((1, 28, 28, 1), np.float32)})
+    assert out["Plus214_Output_0"].shape == (1, 10)
+
+
+def test_image_client_preprocessing(tmp_path):
+    """ImageClient companion: JPEG decode + center-crop resize to the
+    serving tensor (reference Deployment/ImageClient)."""
+    from PIL import Image
+    from tools.image_client import load_image
+    img = Image.fromarray(
+        np.random.default_rng(0).integers(0, 255, (300, 400, 3),
+                                          np.uint8).astype(np.uint8))
+    p = tmp_path / "t.jpg"
+    img.save(p)
+    u8 = load_image(str(p), size=224, dtype=np.uint8)
+    assert u8.shape == (224, 224, 3) and u8.dtype == np.uint8
+    f32 = load_image(str(p), size=224, dtype=np.float32)
+    assert f32.dtype == np.float32 and abs(float(f32.mean())) < 3.0

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""ModelStore: push/pull engine artifacts to an object store
+(reference examples/Deployment/ObjectStore — model artifacts live in
+S3/rook and pods pull them at startup; the TPU deployment analog is a GCS
+bucket mounted/pulled into the pod before serving).
+
+Backends, chosen by URL scheme:
+- ``file://`` (or a bare path): local/NFS directory — fully offline.
+- ``gs://``: Google Cloud Storage via the ``gsutil`` CLI when present
+  (GKE nodes have it; no SDK dependency).
+- ``http(s)://``: read-only pull of a tarball.
+
+An engine artifact is the directory ``Runtime.save_engine`` writes
+(spec.json, params.npz, bucket_*.xla/.shlo); the store moves it as
+``<name>.tar.gz``.  The serving pod pattern (see examples/deploy/README.md)
+is an initContainer running ``model_store.py pull`` into an emptyDir.
+
+    python tools/model_store.py push <artifact-dir> <store-url>/<name>
+    python tools/model_store.py pull <store-url>/<name> <dest-dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tarfile
+import tempfile
+import urllib.parse
+import urllib.request
+
+
+def _tar(artifact_dir: str, out_path: str) -> None:
+    with tarfile.open(out_path, "w:gz") as tf:
+        for entry in sorted(os.listdir(artifact_dir)):
+            tf.add(os.path.join(artifact_dir, entry), arcname=entry)
+
+
+def _untar(tar_path: str, dest_dir: str) -> None:
+    os.makedirs(dest_dir, exist_ok=True)
+    with tarfile.open(tar_path, "r:gz") as tf:
+        tf.extractall(dest_dir, filter="data")  # no paths outside dest
+
+
+def push(artifact_dir: str, url: str) -> None:
+    if not os.path.exists(os.path.join(artifact_dir, "spec.json")):
+        raise SystemExit(f"{artifact_dir} is not an engine artifact "
+                         f"(no spec.json)")
+    scheme = urllib.parse.urlparse(url).scheme
+    with tempfile.TemporaryDirectory() as tmp:
+        tar_path = os.path.join(tmp, "artifact.tar.gz")
+        _tar(artifact_dir, tar_path)
+        if scheme in ("", "file"):
+            dest = url[7:] if scheme == "file" else url
+            if not dest.endswith(".tar.gz"):
+                dest += ".tar.gz"
+            os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+            shutil.copyfile(tar_path, dest)
+            print(f"pushed {artifact_dir} -> {dest}")
+        elif scheme == "gs":
+            subprocess.run(["gsutil", "cp", tar_path, url + ".tar.gz"],
+                           check=True)
+            print(f"pushed {artifact_dir} -> {url}.tar.gz")
+        else:
+            raise SystemExit(f"push not supported for scheme {scheme!r}")
+
+
+def pull(url: str, dest_dir: str) -> None:
+    scheme = urllib.parse.urlparse(url).scheme
+    with tempfile.TemporaryDirectory() as tmp:
+        tar_path = os.path.join(tmp, "artifact.tar.gz")
+        if scheme in ("", "file"):
+            src = url[7:] if scheme == "file" else url
+            if not src.endswith(".tar.gz"):
+                src += ".tar.gz"
+            shutil.copyfile(src, tar_path)
+        elif scheme == "gs":
+            subprocess.run(["gsutil", "cp", url + ".tar.gz", tar_path],
+                           check=True)
+        elif scheme in ("http", "https"):
+            with urllib.request.urlopen(url) as resp, \
+                    open(tar_path, "wb") as f:
+                f.write(resp.read())
+        else:
+            raise SystemExit(f"pull not supported for scheme {scheme!r}")
+        _untar(tar_path, dest_dir)
+    if not os.path.exists(os.path.join(dest_dir, "spec.json")):
+        raise SystemExit(f"pulled archive is not an engine artifact")
+    print(f"pulled {url} -> {dest_dir}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("push")
+    p.add_argument("artifact_dir")
+    p.add_argument("url")
+    p = sub.add_parser("pull")
+    p.add_argument("url")
+    p.add_argument("dest_dir")
+    args = ap.parse_args()
+    if args.cmd == "push":
+        push(args.artifact_dir, args.url)
+    else:
+        pull(args.url, args.dest_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""Run the real-TPU hardware test suite (tests/test_tpu_hw.py).
+
+The main test suite is hermetic (tests/conftest.py forces an 8-device CPU
+mesh before anything touches a backend).  This entry point instead keeps
+the real device: it sets TPULAB_HW_TESTS=1 and monkeypatches the conftest's
+force_cpu to a no-op BEFORE pytest imports it.
+
+    python tools/run_hw_tests.py [extra pytest args]
+"""
+
+import os
+import sys
+
+os.environ["TPULAB_HW_TESTS"] = "1"
+
+from tpulab.tpu import platform as plat  # noqa: E402
+
+plat.force_cpu = lambda *a, **k: None  # conftest's call becomes a no-op
+
+import pytest  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.exit(pytest.main([os.path.join(REPO, "tests", "test_tpu_hw.py"),
+                      "-v", "-s", *sys.argv[1:]]))

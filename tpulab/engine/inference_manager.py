@@ -77,10 +77,11 @@ class InferenceManager:
         log.info("registered %s: weights=%dB activations~%dB buckets=%s",
                  name, model.weights_size_in_bytes(), act, model.batch_buckets)
 
-    def register_engine(self, name: str, path: str, apply_fn,
+    def register_engine(self, name: str, path: str, apply_fn=None,
                         max_concurrency: Optional[int] = None) -> None:
         """Load a serialized engine artifact (reference
-        RegisterModel(name, DeserializeEngine(path)))."""
+        RegisterModel(name, DeserializeEngine(path))).  ``apply_fn`` is
+        optional: artifacts with portable modules load without source."""
         if self._allocated:
             raise RuntimeError("register engines before update_resources()")
         compiled = self._runtime.load_engine(path, apply_fn=apply_fn,

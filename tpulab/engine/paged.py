@@ -21,6 +21,7 @@ TPU-first mechanics:
 
 from __future__ import annotations
 
+import functools
 import threading
 from concurrent.futures import Future
 from functools import partial
@@ -111,6 +112,36 @@ class PagedKVPool:
     def release_pages(self, pages: List[int]) -> None:
         with self._lock:
             self._free.extend(p for p in pages if p)  # 0/None never re-enter
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel_compiles(n_heads: int, head_dim: int, page_size: int,
+                     compute_dtype, device) -> bool:
+    """One-shot probe: does the pallas ragged kernel compile+run on this
+    device for this head geometry?  Cached per geometry; a Mosaic
+    rejection (tiling/VMEM limits) selects the XLA gather fallback."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from tpulab.ops.paged_attention import paged_decode_attention
+    try:
+        q = jax.device_put(jnp.zeros((1, n_heads, head_dim), compute_dtype),
+                           device)
+        kp = jax.device_put(
+            jnp.zeros((2, page_size, n_heads, head_dim), compute_dtype),
+            device)
+        out = paged_decode_attention(
+            q, kp, kp, np.zeros((1, 2), np.int32), np.zeros((1,), np.int32),
+            interpret=False)
+        jax.block_until_ready(out)
+        return True
+    except Exception as e:
+        import logging
+        logging.getLogger("tpulab.engine").warning(
+            "pallas paged-attention kernel unavailable on this device "
+            "(%s: %s); using the XLA gather fallback",
+            type(e).__name__, str(e)[:200])
+        return False
 
 
 def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
@@ -282,7 +313,7 @@ class ContinuousBatcher:
                  pool: Optional[PagedKVPool] = None, lanes: int = 4,
                  max_len: int = 256, page_size: int = 16,
                  n_pages: int = 0, compute_dtype=None, device=None,
-                 use_kernel: bool = False):
+                 use_kernel: Optional[bool] = None):
         import jax
         import jax.numpy as jnp
 
@@ -298,9 +329,20 @@ class ContinuousBatcher:
             n_pages or self.max_pages * lanes + 1, page_size, n_layers,
             n_heads, d_model // n_heads, compute_dtype, device)
         self.params = jax.device_put(params, self.pool.device)
+        if use_kernel is None:
+            # auto: the pallas ragged kernel on TPU (no dense gather in
+            # HBM), the XLA gather fallback elsewhere.  A Mosaic compile
+            # failure must degrade, not kill serving: probe-compile the
+            # kernel once at the POOL's real geometry (page size / heads /
+            # head_dim set the VMEM tiles) and fall back if it rejects.
+            from tpulab.tpu.platform import is_tpu
+            use_kernel = is_tpu() and _kernel_compiles(
+                n_heads, d_model // n_heads, self.pool.page_size,
+                compute_dtype, self.pool.device)
+        self.use_kernel = bool(use_kernel)
         self._step = jax.jit(
             partial(paged_decode_step, n_heads=n_heads, n_layers=n_layers,
-                    compute_dtype=compute_dtype, use_kernel=use_kernel),
+                    compute_dtype=compute_dtype, use_kernel=self.use_kernel),
             donate_argnums=(1, 2))
         # fused prefill, compiled per prompt-length bucket (powers of two)
         self._prefill = jax.jit(
@@ -553,3 +595,54 @@ class ContinuousBatcher:
         self.pool.release_pages(req.pages)
         self._active[lane] = None
         self._requests.pop(req.future, None)
+
+
+def benchmark_decode_kernel_vs_gather(n_heads: int = 8, n_layers: int = 4,
+                                      d_model: int = 1024,
+                                      page_size: int = 32, lanes: int = 8,
+                                      ctx: int = 2048, iters: int = 50,
+                                      dtype=None) -> Dict[str, Any]:
+    """tokens/s of the pallas ragged-paged-attention decode vs the XLA
+    gather fallback at a long-context geometry (the bench perf row and
+    the hardware test share this; VERDICT round-1 #3)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.transformer import init_transformer_params
+
+    dtype = dtype or jnp.bfloat16
+    mp = ctx // page_size
+    params = init_transformer_params(vocab=256, d_model=d_model,
+                                     n_heads=n_heads, n_layers=n_layers,
+                                     d_ff=4 * d_model)
+    tables = np.arange(1, lanes * mp + 1, dtype=np.int32).reshape(lanes, mp)
+    lengths = np.full((lanes,), ctx - 2, np.int32)
+    tokens = np.zeros((lanes,), np.int32)
+    active = np.ones((lanes,), bool)
+    row: Dict[str, Any] = {"b": lanes, "ctx": ctx}
+    for label, uk in (("kernel", True), ("gather", False)):
+        pool = PagedKVPool(lanes * mp + 1, page_size, n_layers, n_heads,
+                           d_model // n_heads, dtype)
+        try:
+            step = jax.jit(partial(
+                paged_decode_step, n_heads=n_heads, n_layers=n_layers,
+                compute_dtype=dtype, use_kernel=uk), donate_argnums=(1, 2))
+            k, v = pool.k, pool.v
+            logits, k, v = step(params, k, v, tables, lengths, tokens,
+                                active)
+            jax.block_until_ready(logits)  # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                logits, k, v = step(params, k, v, tables, lengths, tokens,
+                                    active)
+            jax.block_until_ready(logits)
+            row[f"{label}_tok_s"] = round(
+                lanes * iters / (time.perf_counter() - t0), 1)
+        except Exception as e:
+            row[f"{label}_tok_s"] = 0.0
+            row[f"{label}_error"] = f"{type(e).__name__}: {str(e)[:160]}"
+        finally:
+            pool.close()
+    return row

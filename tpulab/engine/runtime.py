@@ -6,10 +6,14 @@ The TPU "engine artifact" (the TRT plan-file analog) is a directory:
 
     <path>/spec.json            IO contract, buckets, model name
     <path>/params.npz           weight leaves (flattened pytree)
-    <path>/treedef.txt          pytree structure
+    <path>/treedef.pkl          pytree structure
     <path>/bucket_<N>.xla       serialized compiled executable (optional,
                                 topology-specific; recompiled if unusable)
-    <path>/stablehlo_<N>.mlir   portable StableHLO text per bucket
+    <path>/bucket_<N>.shlo      portable jax.export StableHLO module per
+                                bucket — loads WITHOUT the original Python
+                                apply_fn (the TRT property that a plan file
+                                carries the network; per-platform, like a
+                                plan file is per-GPU-arch)
 
 ``CompiledModel`` owns the per-bucket compiled programs for one device — the
 compiled program *is* the cudaGraph analog: one pre-compiled dispatch per
@@ -175,6 +179,35 @@ class Runtime:
             except Exception as e:  # serialization is an optimization only
                 log.warning("executable serialization unavailable (%s); "
                             "artifact will recompile on load", e)
+        # portable program: jax.export StableHLO per bucket — the part of
+        # the artifact that reloads without the Python source (TRT plan
+        # files carry the network; so do we)
+        try:
+            self._save_exported(compiled, path)
+        except Exception as e:
+            log.warning("portable StableHLO export unavailable (%s); "
+                        "artifact will need apply_fn to load", e)
+
+    def _save_exported(self, compiled: CompiledModel, path: str) -> None:
+        import jax
+        from jax import export as jexport
+
+        model = compiled.model
+
+        def call(params, inputs):
+            return model.apply_fn(params, inputs)
+
+        pspec = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            compiled.device_params)
+        for b in model.batch_buckets:
+            dummy = {
+                s.name: jax.ShapeDtypeStruct(s.batched_shape(b), s.np_dtype)
+                for s in model.inputs
+            }
+            exported = jexport.export(jax.jit(call))(pspec, dummy)
+            with open(os.path.join(path, f"bucket_{b}.shlo"), "wb") as f:
+                f.write(exported.serialize())
 
     def load_engine(self, path: str,
                     apply_fn=None, model_name: Optional[str] = None) -> CompiledModel:
@@ -193,9 +226,11 @@ class Runtime:
         inputs = [IOSpec(n, tuple(s), np.dtype(d)) for n, s, d in spec["inputs"]]
         outputs = [IOSpec(n, tuple(s), np.dtype(d)) for n, s, d in spec["outputs"]]
         if apply_fn is None:
-            raise ValueError(
-                "load_engine requires apply_fn (the program source); engine "
-                "artifacts carry weights + IO contract + compiled programs")
+            # portable path: the artifact's jax.export modules ARE the
+            # program — reconstruct apply_fn from the largest bucket's
+            # module so the artifact loads with no Python source (the TRT
+            # plan-file property; recompiles route through the modules too)
+            apply_fn = self._portable_apply_fn(path, spec)
         model = Model(model_name or spec["name"], apply_fn, params,
                       inputs, outputs, spec["max_batch_size"],
                       spec["batch_buckets"])
@@ -206,6 +241,42 @@ class Runtime:
         except BaseException:
             self.allocator.deallocate_node(weights_addr)  # no error-path leak
             raise
+
+    @staticmethod
+    def _portable_apply_fn(path: str, spec: dict):
+        """apply_fn synthesized from the artifact's jax.export modules:
+        dispatches on the batch dimension to the matching bucket's module
+        (each module is shape-exact, like a TRT profile).
+
+        LAZY: modules deserialize on first invocation — an artifact whose
+        serialized .xla executables all validate never touches (or needs)
+        the portable modules."""
+        from jax import export as jexport
+
+        modules: dict = {}
+
+        def _module(b: int):
+            if b not in modules:
+                shlo = os.path.join(path, f"bucket_{b}.shlo")
+                if not os.path.exists(shlo):
+                    raise ValueError(
+                        f"this artifact was loaded without apply_fn and "
+                        f"needs its portable module to (re)compile bucket "
+                        f"{b}, but {shlo} is missing (saved by an older "
+                        f"save_engine, or export was unavailable) — pass "
+                        f"apply_fn to recompile from source")
+                with open(shlo, "rb") as f:
+                    modules[b] = jexport.deserialize(f.read())
+            return modules[b]
+
+        def apply_fn(params, inputs):
+            batch = next(iter(inputs.values())).shape[0]
+            if batch not in spec["batch_buckets"]:
+                raise ValueError(f"no portable module for bucket {batch} "
+                                 f"(have {sorted(spec['batch_buckets'])})")
+            return _module(batch).call(params, inputs)
+
+        return apply_fn
 
     def _load_executables(self, path: str, model: Model, weights_addr,
                           device_params) -> CompiledModel:
