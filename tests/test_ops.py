@@ -102,6 +102,28 @@ def test_paged_attention_matches_gather_reference():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_paged_attention_long_context_exceeds_pipeline_depth():
+    """Contexts longer than the kernel's DMA pipeline depth (_NBUF pages)
+    exercise the in-loop slot refill; a refill racing the slot it is about
+    to read corrupts exactly this regime (pages > _NBUF), which the short
+    tests above never reach."""
+    from tpulab.ops.paged_attention import _NBUF, paged_decode_attention
+    rng = jax.random.PRNGKey(3)
+    mp = 2 * _NBUF + 3          # 19 pages deep — well past the pipeline
+    b, h, d, ps = 2, 2, 16, 4
+    pages = b * mp + 1
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (b, h, d), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (pages, ps, h, d), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (pages, ps, h, d), jnp.float32)
+    tables = (1 + np.arange(b * mp, dtype=np.int32)).reshape(b, mp)
+    lengths = jnp.asarray([mp * ps - 2, _NBUF * ps + 1], jnp.int32)
+    got = paged_decode_attention(q, k_pool, v_pool, tables, lengths)
+    want = _paged_reference(q, k_pool, v_pool, tables, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_paged_attention_skips_dead_pages():
     """Garbage in pages beyond a lane's length must not leak into output."""
     from tpulab.ops.paged_attention import paged_decode_attention

@@ -8,12 +8,23 @@ but materializes the gather in HBM; this kernel instead walks the block
 table per lane, DMA-ing one K/V page at a time from the pool (HBM) into
 VMEM scratch and accumulating softmax online — O(page) VMEM, no gather
 materialization, and dead pages (beyond the lane's length) are skipped by
-predication.  Page DMAs are double-buffered: page j+1 prefetches into the
-alternate VMEM slot while page j computes.
+predication.  Page DMAs ride an ``_NBUF``-deep prefetch pipeline (slot
+rotation: iteration j waits slot ``j % _NBUF``, computes, then refills the
+previous iteration's slot with page ``j + _NBUF - 1``), amortizing the
+per-DMA issue latency across ``_NBUF - 1`` in-flight copies.
 
 Scalar-prefetched block tables/lengths drive the page DMAs (the
 PrefetchScalarGridSpec pattern).  ``interpret=True`` (automatic off TPU)
 runs the same kernel on CPU for hermetic tests.
+
+Mosaic-compatibility note: every dot in the kernel is a plain 2D matmul.
+Per-head contraction is expressed through a loop-invariant one-hot
+head-selector matrix ((H*D, H)) instead of batched ``dot_general``
+dimension numbers — batched dots fail to round-trip through the TPU
+compile service's MLIR text serialization, and middle-dimension DMA
+slices (the per-head-DMA alternative) require 128-lane alignment that
+head_dim=64 models don't satisfy.  Pages are therefore staged as
+(page_size, H*D) rows (a free, contiguous reshape at the caller).
 """
 
 from __future__ import annotations
@@ -29,14 +40,36 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = -1e30
 
 
+_NBUF = 8  # page DMAs in flight: the loop is DMA-latency bound, not VMEM
+# bound (8 slots of a (S, H*D) page are well under a MB), so a deep
+# prefetch pipeline amortizes the per-DMA issue latency across slots
+
+
 def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
                        o_ref, k_buf, v_buf, sem, *, page_size: int,
-                       max_pages: int, sm_scale: float):
+                       max_pages: int, n_heads: int, head_dim: int,
+                       sm_scale: float):
     lane = pl.program_id(0)
     length = lengths_ref[lane]                    # tokens visible (incl. current)
-    h, d = q_ref.shape[1], q_ref.shape[2]
+    h, d, hd = n_heads, head_dim, n_heads * head_dim
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale   # (H, D)
+    q = q_ref[0].astype(jnp.float32) * sm_scale    # (1, H*D)
+    # loop-invariant head selectors (hoisted out of the page loop by the
+    # compiler): sel (H*D, H) sums a row's per-head D-blocks; sel_t expands
+    # per-head scalars back across their D-block
+    blk = jax.lax.broadcasted_iota(jnp.int32, (hd, h), 0) // d
+    col = jax.lax.broadcasted_iota(jnp.int32, (hd, h), 1)
+    sel = (blk == col).astype(jnp.float32)         # (H*D, H)
+    blk_t = jax.lax.broadcasted_iota(jnp.int32, (h, hd), 1) // d
+    row_t = jax.lax.broadcasted_iota(jnp.int32, (h, hd), 0)
+    sel_t = (blk_t == row_t).astype(jnp.float32)   # (H, H*D)
+    # HIGHEST precision: the default rounds f32 MXU operands to bf16, which
+    # would cost ~3 decimal digits on the scores (the selectors themselves
+    # are exact in any precision)
+    dot2 = functools.partial(
+        jax.lax.dot_general, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
 
     def start_dma(j, slot):
         page = tables_ref[lane * max_pages + j]
@@ -55,45 +88,60 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
     def live(j):
         return j * page_size <= length
 
-    # double buffering: prologue fetches page 0; each attend prefetches
-    # page j+1 into the other slot before computing page j.  live(j) is
-    # monotone decreasing, so every started DMA is waited exactly once.
+    # deep prefetch pipeline (N-stage slot rotation): the prologue launches
+    # the first _NBUF-1 live pages; iteration j then waits its slot and
+    # refills the PREVIOUS iteration's slot ((j-1) % _NBUF, provably
+    # consumed — its loads fed the loop-carried accumulator) with page
+    # j+_NBUF-1.  Refilling the CURRENT slot (page j+_NBUF) would start a
+    # DMA into the very buffer this iteration is about to read.  live(j)
+    # is a pure predicate of j (length is constant in-kernel), monotone
+    # decreasing, so every started DMA is waited exactly once.
     start_dma(0, 0)  # page 0 is always live (length >= 0)
+    for jj in range(1, _NBUF - 1):
+        if jj < max_pages:
+            @pl.when(live(jj))
+            def _prologue(jj=jj):
+                start_dma(jj, jj)
 
     def body(j, carry):
         m, l, acc = carry
-        slot = jax.lax.rem(j, 2)
+        slot = jax.lax.rem(j, _NBUF)
 
         def attend(mla):
             m, l, acc = mla
             wait_dma(j, slot)
 
-            @pl.when(jnp.logical_and(j + 1 < max_pages, live(j + 1)))
+            @pl.when(jnp.logical_and(j + _NBUF - 1 < max_pages,
+                                     live(j + _NBUF - 1)))
             def _prefetch():
-                start_dma(j + 1, jax.lax.rem(j + 1, 2))
+                start_dma(j + _NBUF - 1,
+                          jax.lax.rem(j + _NBUF - 1, _NBUF))
 
-            k = k_buf[slot].astype(jnp.float32)   # (S, H, D)
+            k = k_buf[slot].astype(jnp.float32)   # (S, H*D)
             v = v_buf[slot].astype(jnp.float32)
-            s = jnp.einsum("hd,shd->hs", q, k)    # (H, S)
+            s = dot2(k * q, sel)                  # (S, H) per-head scores
             pos = j * page_size + jax.lax.broadcasted_iota(
-                jnp.int32, (h, page_size), 1)
-            mask = pos <= length
+                jnp.int32, (page_size, h), 0)
+            mask = pos <= length                  # (S, H)
             s = jnp.where(mask, s, _NEG)
-            m_new = jnp.maximum(m, s.max(axis=-1))
+            m_new = jnp.maximum(m, s.max(axis=0, keepdims=True))   # (1, H)
             alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new[:, None]) * mask.astype(jnp.float32)
-            l_new = l * alpha + p.sum(axis=-1)
-            acc_new = acc * alpha[:, None] + jnp.einsum("hs,shd->hd", p, v)
+            p = jnp.exp(s - m_new) * mask.astype(jnp.float32)      # (S, H)
+            l_new = l * alpha + p.sum(axis=0, keepdims=True)
+            p_exp = dot2(p, sel_t)                # (S, H*D) head-broadcast
+            contrib = (p_exp * v).sum(axis=0, keepdims=True)       # (1, H*D)
+            acc_new = acc * dot2(alpha, sel_t) + contrib
             return m_new, l_new, acc_new
 
         # pages fully beyond the lane's length contribute nothing — skip
         return jax.lax.cond(live(j), attend, lambda mla: mla, (m, l, acc))
 
-    init = (jnp.full((h,), _NEG, jnp.float32),
-            jnp.zeros((h,), jnp.float32),
-            jnp.zeros((h, d), jnp.float32))
+    init = (jnp.full((1, h), _NEG, jnp.float32),
+            jnp.zeros((1, h), jnp.float32),
+            jnp.zeros((1, hd), jnp.float32))
     m, l, acc = jax.lax.fori_loop(0, max_pages, body, init)
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_exp = dot2(jnp.maximum(l, 1e-30), sel_t)    # (1, H*D)
+    o_ref[0] = (acc / l_exp).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -101,30 +149,38 @@ def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
     b, h, d = q.shape
     n_pages, page_size = k_pool.shape[0], k_pool.shape[1]
     max_pages = tables.shape[1]
+    # stage pages as (S, H*D) rows: contiguous (free) reshape, keeps every
+    # in-kernel dot 2D (see module docstring)
+    # rank-3 (B, 1, H*D) so the (1, 1, H*D) block's last two dims equal the
+    # array dims exactly (the Pallas TPU block tiling rule)
+    q2 = q.reshape(b, 1, h * d)
+    kp2 = k_pool.reshape(n_pages, page_size, h * d)
+    vp2 = v_pool.reshape(n_pages, page_size, h * d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                 # tables (flat), lengths
         grid=(b,),
         in_specs=[
-            pl.BlockSpec((1, h, d), lambda lane, *_: (lane, 0, 0)),
+            pl.BlockSpec((1, 1, h * d), lambda lane, *_: (lane, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),      # K pool stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),      # V pool stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, h, d), lambda lane, *_: (lane, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, h * d), lambda lane, *_: (lane, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, page_size, h, d), k_pool.dtype),  # double buffer
-            pltpu.VMEM((2, page_size, h, d), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),                 # [slot][k/v]
+            pltpu.VMEM((_NBUF, page_size, h * d), k_pool.dtype),
+            pltpu.VMEM((_NBUF, page_size, h * d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((_NBUF, 2)),              # [slot][k/v]
         ],
     )
     kernel = functools.partial(
         _paged_attn_kernel, page_size=page_size, max_pages=max_pages,
-        sm_scale=1.0 / np.sqrt(d))
-    return pl.pallas_call(
+        n_heads=h, head_dim=d, sm_scale=1.0 / np.sqrt(d))
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, 1, h * d), q.dtype),
         interpret=interpret,
-    )(tables.reshape(-1), lengths, q, k_pool, v_pool)
+    )(tables.reshape(-1), lengths, q2, kp2, vp2)
+    return out.reshape(b, h, d)
 
 
 def paged_decode_attention(q, k_pool, v_pool, tables, lengths,
