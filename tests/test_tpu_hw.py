@@ -117,9 +117,17 @@ def test_continuous_batcher_autoselects_kernel_on_tpu():
         import jax
 
         from tpulab.engine.paged import paged_decode_step
-        pool_shape = (2, 9, 16, 2, 128)   # (L, P, S, H, D)
-        tables = np.asarray([[1, 2, 0, 0], [3, 4, 5, 6]], np.int32)
-        lengths = np.asarray([17, 60], np.int32)
+        from tpulab.ops.paged_attention import _NBUF
+        # lane 1's context spans more pages than the kernel's DMA pipeline
+        # depth, so the in-loop slot refill runs on REAL hardware here (the
+        # interpret-mode long-context test cannot catch an async slot-reuse
+        # race — DMAs are synchronous there)
+        mp = _NBUF + 4
+        pool_shape = (2, 2 * mp + 1, 16, 2, 128)   # (L, P, S, H, D)
+        tables = np.zeros((2, mp), np.int32)
+        tables[0, :2] = [1, 2]
+        tables[1] = 2 + np.arange(mp)
+        lengths = np.asarray([17, mp * 16 - 3], np.int32)
         tokens = np.asarray([5, 7], np.int32)
         active = np.ones((2,), bool)
         rng = np.random.default_rng(0)

@@ -40,15 +40,22 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG = -1e30
 
 
-_NBUF = 8  # page DMAs in flight: the loop is DMA-latency bound, not VMEM
-# bound (8 slots of a (S, H*D) page are well under a MB), so a deep
-# prefetch pipeline amortizes the per-DMA issue latency across slots
+_NBUF = 8  # max page DMAs in flight: the loop is DMA-issue-latency bound,
+# so a deep prefetch pipeline amortizes the per-DMA latency across slots.
+# The actual slot count is clamped per geometry so K+V scratch stays
+# within a VMEM budget (see _slot_count).
+_VMEM_BUDGET_BYTES = 8 << 20  # K+V staging combined; v5e VMEM is ~2x this
+
+
+def _slot_count(page_size: int, hd: int, itemsize: int) -> int:
+    page_bytes = page_size * hd * itemsize
+    return max(2, min(_NBUF, _VMEM_BUDGET_BYTES // (2 * page_bytes)))
 
 
 def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
                        o_ref, k_buf, v_buf, sem, *, page_size: int,
                        max_pages: int, n_heads: int, head_dim: int,
-                       sm_scale: float):
+                       sm_scale: float, precision, nbuf: int):
     lane = pl.program_id(0)
     length = lengths_ref[lane]                    # tokens visible (incl. current)
     h, d, hd = n_heads, head_dim, n_heads * head_dim
@@ -63,10 +70,15 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
     blk_t = jax.lax.broadcasted_iota(jnp.int32, (h, hd), 1) // d
     row_t = jax.lax.broadcasted_iota(jnp.int32, (h, hd), 0)
     sel_t = (blk_t == row_t).astype(jnp.float32)   # (H, H*D)
-    # HIGHEST precision: the default rounds f32 MXU operands to bf16, which
-    # would cost ~3 decimal digits on the scores (the selectors themselves
-    # are exact in any precision)
+    # score dot: operands are pool/query data — precision follows the pool
+    # dtype (bf16 data carries no extra bits for HIGHEST to preserve).
+    # selector-expansion dots: operands are f32 softmax intermediates
+    # (p, alpha, l) — ALWAYS HIGHEST, or the running rescale would round
+    # to bf16 on every page and compound across the context walk.
     dot2 = functools.partial(
+        jax.lax.dot_general, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+    dot_sel = functools.partial(
         jax.lax.dot_general, dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST)
@@ -89,15 +101,15 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
         return j * page_size <= length
 
     # deep prefetch pipeline (N-stage slot rotation): the prologue launches
-    # the first _NBUF-1 live pages; iteration j then waits its slot and
-    # refills the PREVIOUS iteration's slot ((j-1) % _NBUF, provably
+    # the first nbuf-1 live pages; iteration j then waits its slot and
+    # refills the PREVIOUS iteration's slot ((j-1) % nbuf, provably
     # consumed — its loads fed the loop-carried accumulator) with page
-    # j+_NBUF-1.  Refilling the CURRENT slot (page j+_NBUF) would start a
+    # j+nbuf-1.  Refilling the CURRENT slot (page j+nbuf) would start a
     # DMA into the very buffer this iteration is about to read.  live(j)
     # is a pure predicate of j (length is constant in-kernel), monotone
     # decreasing, so every started DMA is waited exactly once.
     start_dma(0, 0)  # page 0 is always live (length >= 0)
-    for jj in range(1, _NBUF - 1):
+    for jj in range(1, nbuf - 1):
         if jj < max_pages:
             @pl.when(live(jj))
             def _prologue(jj=jj):
@@ -105,17 +117,17 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
 
     def body(j, carry):
         m, l, acc = carry
-        slot = jax.lax.rem(j, _NBUF)
+        slot = jax.lax.rem(j, nbuf)
 
         def attend(mla):
             m, l, acc = mla
             wait_dma(j, slot)
 
-            @pl.when(jnp.logical_and(j + _NBUF - 1 < max_pages,
-                                     live(j + _NBUF - 1)))
+            @pl.when(jnp.logical_and(j + nbuf - 1 < max_pages,
+                                     live(j + nbuf - 1)))
             def _prefetch():
-                start_dma(j + _NBUF - 1,
-                          jax.lax.rem(j + _NBUF - 1, _NBUF))
+                start_dma(j + nbuf - 1,
+                          jax.lax.rem(j + nbuf - 1, nbuf))
 
             k = k_buf[slot].astype(jnp.float32)   # (S, H*D)
             v = v_buf[slot].astype(jnp.float32)
@@ -128,9 +140,9 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
             alpha = jnp.exp(m - m_new)
             p = jnp.exp(s - m_new) * mask.astype(jnp.float32)      # (S, H)
             l_new = l * alpha + p.sum(axis=0, keepdims=True)
-            p_exp = dot2(p, sel_t)                # (S, H*D) head-broadcast
+            p_exp = dot_sel(p, sel_t)             # (S, H*D) head-broadcast
             contrib = (p_exp * v).sum(axis=0, keepdims=True)       # (1, H*D)
-            acc_new = acc * dot2(alpha, sel_t) + contrib
+            acc_new = acc * dot_sel(alpha, sel_t) + contrib
             return m_new, l_new, acc_new
 
         # pages fully beyond the lane's length contribute nothing — skip
@@ -140,7 +152,7 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
             jnp.zeros((1, h), jnp.float32),
             jnp.zeros((1, hd), jnp.float32))
     m, l, acc = jax.lax.fori_loop(0, max_pages, body, init)
-    l_exp = dot2(jnp.maximum(l, 1e-30), sel_t)    # (1, H*D)
+    l_exp = dot_sel(jnp.maximum(l, 1e-30), sel_t)  # (1, H*D)
     o_ref[0] = (acc / l_exp).astype(o_ref.dtype)
 
 
@@ -156,6 +168,7 @@ def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
     q2 = q.reshape(b, 1, h * d)
     kp2 = k_pool.reshape(n_pages, page_size, h * d)
     vp2 = v_pool.reshape(n_pages, page_size, h * d)
+    nbuf = _slot_count(page_size, h * d, jnp.dtype(k_pool.dtype).itemsize)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                 # tables (flat), lengths
         grid=(b,),
@@ -166,14 +179,21 @@ def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
         ],
         out_specs=pl.BlockSpec((1, 1, h * d), lambda lane, *_: (lane, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((_NBUF, page_size, h * d), k_pool.dtype),
-            pltpu.VMEM((_NBUF, page_size, h * d), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((_NBUF, 2)),              # [slot][k/v]
+            pltpu.VMEM((nbuf, page_size, h * d), k_pool.dtype),
+            pltpu.VMEM((nbuf, page_size, h * d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((nbuf, 2)),               # [slot][k/v]
         ],
     )
+    # f32 pools pin HIGHEST on the score dot (the default rounds f32 MXU
+    # operands to bf16, costing ~3 decimal digits); bf16 pools keep the
+    # fast default — the score operands carry no extra bits to preserve
+    precision = (jax.lax.Precision.HIGHEST
+                 if jnp.dtype(k_pool.dtype).itemsize >= 4
+                 else jax.lax.Precision.DEFAULT)
     kernel = functools.partial(
         _paged_attn_kernel, page_size=page_size, max_pages=max_pages,
-        n_heads=h, head_dim=d, sm_scale=1.0 / np.sqrt(d))
+        n_heads=h, head_dim=d, sm_scale=1.0 / np.sqrt(d),
+        precision=precision, nbuf=nbuf)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
