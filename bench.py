@@ -174,7 +174,6 @@ def main() -> None:
                                  and os.environ.get("TPULAB_NO_NATIVE") != "1"))
     except Exception:
         _record(native_core=False)
-    t_start = time.time()
     if not degraded and not cpu_full:
         # host<->device link ceiling (the tunnel, on relay-attached chips):
         # pipeline numbers below are bounded by this, not by the chip —
@@ -208,6 +207,7 @@ def main() -> None:
             print(f"# link probe skipped: {e!r}", file=sys.stderr)
     # degraded (CPU-fallback) mode shrinks the sweep: the number is a
     # liveness datapoint, not a comparable benchmark
+    t_start = time.time()  # after the link probe: compile_s is compile only
     _phase("compile")
     buckets = [1, 8] if degraded else [1, 8, 128]
     sweep = ((1, 2.0), (8, 2.0)) if degraded else \
@@ -293,6 +293,28 @@ def main() -> None:
     np.asarray(_chain(dev_params, dev_img))
     _record(compute_only_b128_inf_s=round(
         cb * n / (time.perf_counter() - t0), 1))
+
+    # full-INT8 (W8A8) compute ceiling: int8 x int8 -> int32 convs on the
+    # MXU — the dtype-for-dtype comparison against the reference's INT8
+    # headline (examples/ONNX/resnet50/int8.py calibrated engines)
+    if not degraded:
+        _phase("compute_only_w8a8")
+        try:
+            from tpulab.models.quantization import (
+                calibrate_resnet, quantize_resnet_params_w8a8)
+            cal = np.random.default_rng(0).standard_normal(
+                (4, 224, 224, 3)).astype(np.float32)
+            ranges = calibrate_resnet(model.params, [cal])
+            qp = jax.device_put(
+                quantize_resnet_params_w8a8(model.params, ranges),
+                mgr.device)
+            np.asarray(_chain(qp, dev_img))  # compile + warm
+            t0 = time.perf_counter()
+            np.asarray(_chain(qp, dev_img))
+            _record(compute_only_w8a8_b128_inf_s=round(
+                cb * n / (time.perf_counter() - t0), 1))
+        except Exception as e:
+            print(f"# w8a8 row skipped: {e!r}", file=sys.stderr)
 
     # per-stage decomposition at b=1, sequential (the measured answer to
     # "where does the millisecond go": host staging, H2D, compute, D2H)

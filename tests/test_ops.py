@@ -68,12 +68,15 @@ def test_flash_in_transformer():
 
 # ---------------------------------------------------------- paged attention --
 def _paged_reference(q, k_pool, v_pool, tables, lengths):
-    """Dense-gather reference (mirrors engine.paged's XLA fallback math)."""
+    """Dense-gather reference (mirrors engine.paged's XLA fallback math);
+    handles GQA pools (Hkv < Hq) by repeating KV heads."""
     b, h, d = q.shape
-    page_size = k_pool.shape[1]
+    page_size, hkv = k_pool.shape[1], k_pool.shape[2]
     mp = tables.shape[1]
-    k_ctx = k_pool[tables].reshape(b, mp * page_size, h, d)
-    v_ctx = v_pool[tables].reshape(b, mp * page_size, h, d)
+    k_ctx = jnp.repeat(k_pool[tables].reshape(b, mp * page_size, hkv, d),
+                       h // hkv, axis=2)
+    v_ctx = jnp.repeat(v_pool[tables].reshape(b, mp * page_size, hkv, d),
+                       h // hkv, axis=2)
     scores = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
                         k_ctx.astype(jnp.float32)) / np.sqrt(d)
     pos = jnp.arange(mp * page_size)
@@ -96,6 +99,24 @@ def test_paged_attention_matches_gather_reference():
     # skip predicate must still attend the fresh page's first slot
     tables = jnp.asarray([[1, 2, 3], [4, 5, 7], [6, 0, 0]], jnp.int32)
     lengths = jnp.asarray([20, 16, 3], jnp.int32)
+    got = paged_decode_attention(q, k_pool, v_pool, tables, lengths)
+    want = _paged_reference(q, k_pool, v_pool, tables, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_attention_gqa_matches_expanded_reference():
+    """GQA pools (Hkv < Hq): the kernel's in-VMEM head broadcast must match
+    the dense reference with explicitly repeated KV heads."""
+    from tpulab.ops.paged_attention import paged_decode_attention
+    rng = jax.random.PRNGKey(7)
+    b, hq, hkv, d, pages, ps, mp = 3, 8, 2, 16, 10, 8, 3
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (b, hq, d), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (pages, ps, hkv, d), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (pages, ps, hkv, d), jnp.float32)
+    tables = jnp.asarray([[1, 2, 3], [4, 5, 7], [6, 8, 9]], jnp.int32)
+    lengths = jnp.asarray([21, 8, 2], jnp.int32)
     got = paged_decode_attention(q, k_pool, v_pool, tables, lengths)
     want = _paged_reference(q, k_pool, v_pool, tables, lengths)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),

@@ -224,6 +224,36 @@ def test_generation_engine_sessions():
     np.testing.assert_array_equal(np.asarray(again), batch)
 
 
+def test_generation_engine_gqa_sessions():
+    """GQA params through the dense session API: compact caches, streaming
+    session == one-shot generate, == the paged batcher's tokens."""
+    import jax.numpy as jnp
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.engine.paged import ContinuousBatcher
+    from tpulab.models.transformer import init_transformer_params
+
+    params = init_transformer_params(vocab=64, d_model=64, n_heads=4,
+                                     n_layers=2, d_ff=64, n_kv_heads=2)
+    eng = GenerationEngine(params, n_heads=4, n_layers=2, max_len=48,
+                           max_sessions=1, compute_dtype=jnp.float32,
+                           n_kv_heads=2)
+    prompt = np.random.default_rng(1).integers(0, 64, (6,), np.int32)
+    with eng.start_session() as s:
+        s.prefill(prompt)
+        streamed = list(s.stream(5))
+    batch = eng.generate(prompt[None, :], 5)[0]
+    np.testing.assert_array_equal(np.asarray(streamed), batch)
+
+    cb = ContinuousBatcher(params, n_heads=4, n_layers=2, lanes=1,
+                           max_len=48, page_size=8,
+                           compute_dtype=jnp.float32, n_kv_heads=2)
+    try:
+        paged = cb.submit(prompt, 5).result(timeout=120)
+        np.testing.assert_array_equal(np.asarray(paged), batch)
+    finally:
+        cb.shutdown()
+
+
 def test_generation_session_backpressure_and_limits():
     import jax.numpy as jnp
     from tpulab.engine.generation import GenerationEngine

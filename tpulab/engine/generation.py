@@ -28,7 +28,8 @@ class GenerationEngine:
 
     def __init__(self, params: Any, n_heads: int, n_layers: int,
                  max_len: int = 1024, max_sessions: int = 2,
-                 compute_dtype=None, device=None):
+                 compute_dtype=None, device=None,
+                 n_kv_heads: Optional[int] = None):
         import jax
         import jax.numpy as jnp
         from tpulab.models.transformer import (init_kv_cache,
@@ -41,6 +42,7 @@ class GenerationEngine:
         self.compute_dtype = compute_dtype
         self.n_heads = n_heads
         self.n_layers = n_layers
+        self.n_kv_heads = n_kv_heads or n_heads
         self.max_len = max_len
         self.params = jax.device_put(params, self.device)
         d_model = params["layer0"]["wqkv"].shape[0]
@@ -48,12 +50,15 @@ class GenerationEngine:
 
         self._decode = jax.jit(partial(
             transformer_decode_step, n_heads=n_heads, n_layers=n_layers,
-            compute_dtype=compute_dtype))
+            compute_dtype=compute_dtype, n_kv_heads=self.n_kv_heads))
         self._generate = make_generate_fn(self.params, n_heads, n_layers,
-                                          max_len, compute_dtype)
-        # cache slots: the generation analog of execution-context pooling
+                                          max_len, compute_dtype,
+                                          n_kv_heads=self.n_kv_heads)
+        # cache slots hold the compact n_kv_heads form under GQA: the
+        # generation analog of execution-context pooling
         self._init_cache = partial(init_kv_cache, 1, max_len, n_layers,
-                                   n_heads, self.head_dim, compute_dtype)
+                                   self.n_kv_heads, self.head_dim,
+                                   compute_dtype)
         self._sessions: Pool = Pool(
             (self._init_cache() for _ in range(max_sessions)))
 

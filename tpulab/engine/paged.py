@@ -116,7 +116,8 @@ class PagedKVPool:
 
 @functools.lru_cache(maxsize=None)
 def _kernel_compiles(n_heads: int, head_dim: int, page_size: int,
-                     compute_dtype, device) -> bool:
+                     compute_dtype, device,
+                     n_kv_heads: Optional[int] = None) -> bool:
     """One-shot probe: does the pallas ragged kernel compile+run on this
     device for this head geometry?  Cached per geometry; a Mosaic
     rejection (tiling/VMEM limits) selects the XLA gather fallback."""
@@ -128,7 +129,8 @@ def _kernel_compiles(n_heads: int, head_dim: int, page_size: int,
         q = jax.device_put(jnp.zeros((1, n_heads, head_dim), compute_dtype),
                            device)
         kp = jax.device_put(
-            jnp.zeros((2, page_size, n_heads, head_dim), compute_dtype),
+            jnp.zeros((2, page_size, n_kv_heads or n_heads, head_dim),
+                      compute_dtype),
             device)
         out = paged_decode_attention(
             q, kp, kp, np.zeros((1, 2), np.int32), np.zeros((1,), np.int32),
@@ -146,17 +148,21 @@ def _kernel_compiles(n_heads: int, head_dim: int, page_size: int,
 
 def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
                       active, n_heads: int, n_layers: int,
-                      compute_dtype, use_kernel: bool = False):
+                      compute_dtype, use_kernel: bool = False,
+                      n_kv_heads: Optional[int] = None):
     """One batched decode tick over the paged pool.
 
     Shapes: tables (B, MP) int32 page ids (padded rows repeat page 0),
     lengths (B,) current position per lane, tokens (B,), active (B,) bool.
     Returns (logits (B, vocab), k_pool, v_pool) — pools donated by caller.
+    Under GQA (``n_kv_heads < n_heads``) the pools hold ``n_kv_heads``
+    heads per slot.
     """
     import jax
     import jax.numpy as jnp
-    from tpulab.models.transformer import _rmsnorm
+    from tpulab.models.transformer import _rmsnorm, repeat_kv, split_qkv
 
+    n_kv = n_kv_heads or n_heads
     b = tokens.shape[0]
     page_size = k_pool.shape[2]
     mp = tables.shape[1]
@@ -172,10 +178,9 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
         p = params[f"layer{layer}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
         qkv = h @ p["wqkv"].astype(compute_dtype)
-        q, knew, vnew = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, 1, n_heads, head_dim)
-        knew = knew.reshape(b, n_heads, head_dim).astype(k_pool.dtype)
-        vnew = vnew.reshape(b, n_heads, head_dim).astype(v_pool.dtype)
+        q, knew, vnew = split_qkv(qkv, b, 1, n_heads, n_kv, head_dim)
+        knew = knew[:, 0].astype(k_pool.dtype)      # (B, Hkv, D)
+        vnew = vnew[:, 0].astype(v_pool.dtype)
         # scatter the new K/V into their pages; inactive/padded lanes are
         # routed to the RESERVED scratch page 0 so they can never clobber
         # a live lane's pages
@@ -192,10 +197,12 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
             ).astype(compute_dtype).reshape(b, 1, d_model)
         else:
             # XLA fallback: gather pages densely then mask
-            k_ctx = k_pool[layer][tables].reshape(b, mp * page_size, n_heads,
-                                                  head_dim)
-            v_ctx = v_pool[layer][tables].reshape(b, mp * page_size, n_heads,
-                                                  head_dim)
+            k_ctx = repeat_kv(
+                k_pool[layer][tables].reshape(b, mp * page_size, n_kv,
+                                              head_dim), n_heads)
+            v_ctx = repeat_kv(
+                v_pool[layer][tables].reshape(b, mp * page_size, n_kv,
+                                              head_dim), n_heads)
             scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                                 k_ctx.astype(jnp.float32)) / np.sqrt(head_dim)
             pos = jnp.arange(mp * page_size)
@@ -218,7 +225,8 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
 
 
 def paged_prefill(params, k_pool, v_pool, tables, tokens, valid_len,
-                  n_heads: int, n_layers: int, compute_dtype):
+                  n_heads: int, n_layers: int, compute_dtype,
+                  n_kv_heads: Optional[int] = None):
     """Fused prefill: ONE causal forward over the (padded) prompt, with each
     layer's K/V scattered straight into the lane's pages.
 
@@ -235,7 +243,7 @@ def paged_prefill(params, k_pool, v_pool, tables, tokens, valid_len,
     t_pad = tokens.shape[1]
     logits, kvs = transformer_forward_collect_kv(
         params, tokens, n_heads=n_heads, n_layers=n_layers,
-        compute_dtype=compute_dtype)
+        compute_dtype=compute_dtype, n_kv_heads=n_kv_heads)
     pos = jnp.arange(t_pad)
     valid = pos < valid_len
     page_idx = jnp.where(valid, tables[pos // page_size], 0)  # scratch if pad
@@ -313,21 +321,24 @@ class ContinuousBatcher:
                  pool: Optional[PagedKVPool] = None, lanes: int = 4,
                  max_len: int = 256, page_size: int = 16,
                  n_pages: int = 0, compute_dtype=None, device=None,
-                 use_kernel: Optional[bool] = None):
+                 use_kernel: Optional[bool] = None,
+                 n_kv_heads: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
         compute_dtype = compute_dtype or jnp.bfloat16
+        n_kv = n_kv_heads or n_heads
         self.lanes = lanes
         self.max_len = max_len
         self.page_size = page_size
         self.max_pages = (max_len + page_size - 1) // page_size
         d_model = params["layer0"]["wqkv"].shape[0]
-        # +1: page 0 is the reserved scratch page
+        # +1: page 0 is the reserved scratch page.  GQA pools store the
+        # compact n_kv_heads form — KV HBM shrinks by n_heads/n_kv_heads.
         self._owns_pool = pool is None
         self.pool = pool or PagedKVPool(
             n_pages or self.max_pages * lanes + 1, page_size, n_layers,
-            n_heads, d_model // n_heads, compute_dtype, device)
+            n_kv, d_model // n_heads, compute_dtype, device)
         self.params = jax.device_put(params, self.pool.device)
         if use_kernel is None:
             # auto: the pallas ragged kernel on TPU (no dense gather in
@@ -338,16 +349,17 @@ class ContinuousBatcher:
             from tpulab.tpu.platform import is_tpu
             use_kernel = is_tpu() and _kernel_compiles(
                 n_heads, d_model // n_heads, self.pool.page_size,
-                compute_dtype, self.pool.device)
+                compute_dtype, self.pool.device, n_kv_heads=n_kv)
         self.use_kernel = bool(use_kernel)
         self._step = jax.jit(
             partial(paged_decode_step, n_heads=n_heads, n_layers=n_layers,
-                    compute_dtype=compute_dtype, use_kernel=self.use_kernel),
+                    compute_dtype=compute_dtype, use_kernel=self.use_kernel,
+                    n_kv_heads=n_kv),
             donate_argnums=(1, 2))
         # fused prefill, compiled per prompt-length bucket (powers of two)
         self._prefill = jax.jit(
             partial(paged_prefill, n_heads=n_heads, n_layers=n_layers,
-                    compute_dtype=compute_dtype),
+                    compute_dtype=compute_dtype, n_kv_heads=n_kv),
             donate_argnums=(1, 2))
         self._queue: List[_PagedRequest] = []
         self._requests: Dict[Future, _PagedRequest] = {}

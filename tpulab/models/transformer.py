@@ -19,7 +19,17 @@ import numpy as np
 
 def init_transformer_params(vocab: int = 32000, d_model: int = 512,
                             n_heads: int = 8, n_layers: int = 6,
-                            d_ff: int = 2048, seed: int = 0) -> Dict[str, Any]:
+                            d_ff: int = 2048, seed: int = 0,
+                            n_kv_heads: Optional[int] = None) -> Dict[str, Any]:
+    """``n_kv_heads < n_heads`` selects grouped-query attention (GQA;
+    ``n_kv_heads=1`` is MQA): K/V projections shrink to ``n_kv_heads``
+    heads, cutting KV-cache HBM and decode bandwidth by the group factor.
+    Default (None) is standard multi-head attention."""
+    n_kv = n_kv_heads or n_heads
+    if n_heads % n_kv:
+        raise ValueError(f"n_heads {n_heads} not divisible by "
+                         f"n_kv_heads {n_kv}")
+    head_dim = d_model // n_heads
     rng = jax.random.PRNGKey(seed)
     keys = iter(jax.random.split(rng, 4 * n_layers + 4))
     s = 0.02
@@ -31,12 +41,32 @@ def init_transformer_params(vocab: int = 32000, d_model: int = 512,
         params[f"layer{i}"] = {
             "ln1": {"scale": jnp.ones((d_model,))},
             "ln2": {"scale": jnp.ones((d_model,))},
-            "wqkv": jax.random.normal(next(keys), (d_model, 3 * d_model)) * s,
+            "wqkv": jax.random.normal(
+                next(keys),
+                (d_model, (n_heads + 2 * n_kv) * head_dim)) * s,
             "wo": jax.random.normal(next(keys), (d_model, d_model)) * s,
             "w1": jax.random.normal(next(keys), (d_model, d_ff)) * s,
             "w2": jax.random.normal(next(keys), (d_ff, d_model)) * s,
         }
     return params
+
+
+def split_qkv(qkv, b, t, n_heads, n_kv_heads, head_dim):
+    """Split a fused QKV projection into (q (B,T,Hq,D), k/v (B,T,Hkv,D))."""
+    q_dim = n_heads * head_dim
+    kv_dim = n_kv_heads * head_dim
+    q = qkv[..., :q_dim].reshape(b, t, n_heads, head_dim)
+    k = qkv[..., q_dim:q_dim + kv_dim].reshape(b, t, n_kv_heads, head_dim)
+    v = qkv[..., q_dim + kv_dim:].reshape(b, t, n_kv_heads, head_dim)
+    return q, k, v
+
+
+def repeat_kv(kv, n_heads):
+    """Broadcast (…, Hkv, D) K/V heads up to the query head count (GQA)."""
+    hkv = kv.shape[-2]
+    if hkv == n_heads:
+        return kv
+    return jnp.repeat(kv, n_heads // hkv, axis=-2)
 
 
 def _rmsnorm(x, scale):
@@ -67,9 +97,13 @@ def _dense_ffn(p, h, compute_dtype):
 
 
 def _forward(params, tokens, n_heads, n_layers, compute_dtype, attention_fn,
-             collect_kv: bool = False, ffn_fn=_dense_ffn):
+             collect_kv: bool = False, ffn_fn=_dense_ffn,
+             n_kv_heads: Optional[int] = None):
     """Shared transformer trunk: (B, T) tokens -> (logits, kvs or None).
-    ``ffn_fn(layer_params, h, compute_dtype)`` swaps the FFN (dense / MoE)."""
+    ``ffn_fn(layer_params, h, compute_dtype)`` swaps the FFN (dense / MoE).
+    ``collect_kv`` returns the UNexpanded (B, T, Hkv, D) heads — the
+    compact form KV caches/pools store under GQA."""
+    n_kv = n_kv_heads or n_heads
     emb = params["embed"].astype(compute_dtype)
     x = emb[tokens]
     b, t, d_model = x.shape
@@ -79,13 +113,11 @@ def _forward(params, tokens, n_heads, n_layers, compute_dtype, attention_fn,
         p = params[f"layer{i}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
         qkv = h @ p["wqkv"].astype(compute_dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, t, n_heads, head_dim)
-        k = k.reshape(b, t, n_heads, head_dim)
-        v = v.reshape(b, t, n_heads, head_dim)
+        q, k, v = split_qkv(qkv, b, t, n_heads, n_kv, head_dim)
         if collect_kv:
             kvs.append((k, v))
-        attn = attention_fn(q, k, v).reshape(b, t, d_model)
+        attn = attention_fn(q, repeat_kv(k, n_heads),
+                            repeat_kv(v, n_heads)).reshape(b, t, d_model)
         x = x + attn @ p["wo"].astype(compute_dtype)
         h = _rmsnorm(x, p["ln2"]["scale"])
         x = x + ffn_fn(p, h, compute_dtype).astype(x.dtype)
@@ -97,23 +129,28 @@ def _forward(params, tokens, n_heads, n_layers, compute_dtype, attention_fn,
 def transformer_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
                       n_heads: int = 8, n_layers: int = 6,
                       compute_dtype=jnp.bfloat16,
-                      attention_fn: Callable = causal_attention
+                      attention_fn: Callable = causal_attention,
+                      n_kv_heads: Optional[int] = None
                       ) -> Dict[str, jnp.ndarray]:
     """tokens (B, T) int32 -> logits (B, T, vocab) f32."""
     logits, _ = _forward(params, inputs["tokens"], n_heads, n_layers,
-                         compute_dtype, attention_fn)
+                         compute_dtype, attention_fn,
+                         n_kv_heads=n_kv_heads)
     return {"logits": logits}
 
 
 def make_transformer(vocab: int = 32000, d_model: int = 512, n_heads: int = 8,
                      n_layers: int = 6, d_ff: int = 2048, seq_len: int = 1024,
                      max_batch_size: int = 4, compute_dtype=jnp.bfloat16,
-                     seed: int = 0, attention_fn: Callable = causal_attention):
+                     seed: int = 0, attention_fn: Callable = causal_attention,
+                     n_kv_heads: Optional[int] = None):
     from tpulab.engine.model import IOSpec, Model
 
-    params = init_transformer_params(vocab, d_model, n_heads, n_layers, d_ff, seed)
+    params = init_transformer_params(vocab, d_model, n_heads, n_layers,
+                                     d_ff, seed, n_kv_heads=n_kv_heads)
     apply_fn = partial(transformer_apply, n_heads=n_heads, n_layers=n_layers,
-                       compute_dtype=compute_dtype, attention_fn=attention_fn)
+                       compute_dtype=compute_dtype, attention_fn=attention_fn,
+                       n_kv_heads=n_kv_heads)
     return Model(
         name="transformer",
         apply_fn=apply_fn,
@@ -130,7 +167,8 @@ def make_transformer(vocab: int = 32000, d_model: int = 512, n_heads: int = 8,
 
 def init_kv_cache(batch: int, max_len: int, n_layers: int, n_heads: int,
                   head_dim: int, dtype=jnp.bfloat16) -> Dict[str, Any]:
-    """Preallocated per-layer K/V rings (B, T_max, H, Dh)."""
+    """Preallocated per-layer K/V rings (B, T_max, H, Dh) — pass the KV
+    head count here (``n_kv_heads`` under GQA)."""
     shape = (batch, max_len, n_heads, head_dim)
     return {f"layer{i}": {"k": jnp.zeros(shape, dtype),
                           "v": jnp.zeros(shape, dtype)}
@@ -140,13 +178,15 @@ def init_kv_cache(batch: int, max_len: int, n_layers: int, n_heads: int,
 def transformer_decode_step(params: Dict[str, Any], cache: Dict[str, Any],
                             tokens: jnp.ndarray, pos: jnp.ndarray,
                             n_heads: int = 8, n_layers: int = 6,
-                            compute_dtype=jnp.bfloat16):
+                            compute_dtype=jnp.bfloat16,
+                            n_kv_heads: Optional[int] = None):
     """One decode step: tokens (B,) int32 at position ``pos`` (scalar int32).
 
     Returns (logits (B, vocab) f32, updated cache).  Attention runs against
     cache[: pos+1] via position masking — static shapes, scan/jit friendly
     (no data-dependent Python control flow).
     """
+    n_kv = n_kv_heads or n_heads
     emb = params["embed"].astype(compute_dtype)
     x = emb[tokens][:, None, :]                     # (B, 1, D)
     b, _, d_model = x.shape
@@ -157,10 +197,7 @@ def transformer_decode_step(params: Dict[str, Any], cache: Dict[str, Any],
         p = params[f"layer{i}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
         qkv = h @ p["wqkv"].astype(compute_dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, 1, n_heads, head_dim)
-        k = k.reshape(b, 1, n_heads, head_dim)
-        v = v.reshape(b, 1, n_heads, head_dim)
+        q, k, v = split_qkv(qkv, b, 1, n_heads, n_kv, head_dim)
         ck = jax.lax.dynamic_update_slice(
             cache[f"layer{i}"]["k"], k.astype(cache[f"layer{i}"]["k"].dtype),
             (0, pos, 0, 0))
@@ -169,13 +206,19 @@ def transformer_decode_step(params: Dict[str, Any], cache: Dict[str, Any],
             (0, pos, 0, 0))
         new_cache[f"layer{i}"] = {"k": ck, "v": cv}
         # attend against positions <= pos (masked full-ring attention:
-        # static shapes; masked lanes cost FLOPs but keep XLA happy)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+        # static shapes; masked lanes cost FLOPs but keep XLA happy).
+        # GQA: group the QUERY heads (B, 1, Hkv, G, D) against the compact
+        # cache — no (B, T, Hq, D) expansion materializes, so the cache
+        # read stays at the Hkv bandwidth GQA exists for.
+        g = n_heads // n_kv
+        qg = q.reshape(b, 1, n_kv, g, head_dim)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
                             ck.astype(jnp.float32)) / np.sqrt(head_dim)
         k_pos = jnp.arange(max_len)
-        scores = jnp.where(k_pos[None, None, None, :] <= pos, scores, -1e30)
+        scores = jnp.where(
+            k_pos[None, None, None, None, :] <= pos, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(compute_dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs,
+        attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs,
                           cv.astype(compute_dtype)).reshape(b, 1, d_model)
         x = x + attn @ p["wo"].astype(compute_dtype)
         h2 = _rmsnorm(x, p["ln2"]["scale"])
@@ -188,7 +231,8 @@ def transformer_decode_step(params: Dict[str, Any], cache: Dict[str, Any],
 
 
 def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
-                     max_len: int, compute_dtype=jnp.bfloat16):
+                     max_len: int, compute_dtype=jnp.bfloat16,
+                     n_kv_heads: Optional[int] = None):
     """Jitted greedy generation: (prompt (B, T_p), steps) -> (B, steps).
 
     Prefill replays the prompt through scanned decode steps to warm the
@@ -197,10 +241,12 @@ def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
     compiler-friendly: no growing shapes, no recompiles per step.
     """
 
+    n_kv = n_kv_heads or n_heads
+
     def generate(prompt: jnp.ndarray, steps: int):
         b, t_p = prompt.shape
-        head_dim = params["layer0"]["wqkv"].shape[0] // n_heads
-        cache = init_kv_cache(b, max_len, n_layers, n_heads, head_dim,
+        head_dim = params["embed"].shape[1] // n_heads
+        cache = init_kv_cache(b, max_len, n_layers, n_kv, head_dim,
                               compute_dtype)
         # prefill: run the full forward for logits, then replay the prompt
         # through decode steps to warm the cache (simple, correct; a fused
@@ -209,7 +255,7 @@ def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
             cache, _ = carry
             logits, cache = transformer_decode_step(
                 params, cache, prompt[:, i], i, n_heads, n_layers,
-                compute_dtype)
+                compute_dtype, n_kv_heads=n_kv)
             return (cache, logits), None
 
         (cache, logits), _ = jax.lax.scan(
@@ -219,7 +265,8 @@ def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
         def decode_body(carry, i):
             cache, tok = carry
             logits, cache = transformer_decode_step(
-                params, cache, tok, t_p + i, n_heads, n_layers, compute_dtype)
+                params, cache, tok, t_p + i, n_heads, n_layers,
+                compute_dtype, n_kv_heads=n_kv)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (cache, nxt), nxt
 
@@ -235,13 +282,14 @@ def transformer_forward_collect_kv(params: Dict[str, Any],
                                    tokens: jnp.ndarray,
                                    n_heads: int = 8, n_layers: int = 6,
                                    compute_dtype=jnp.bfloat16,
-                                   attention_fn: Callable = causal_attention):
+                                   attention_fn: Callable = causal_attention,
+                                   n_kv_heads: Optional[int] = None):
     """Causal forward over (B, T) tokens that also returns each layer's
-    K/V (B, T, H, Dh) — the fused-prefill building block: one forward fills
-    a whole prompt's KV instead of T decode steps.  Shares the trunk with
-    :func:`transformer_apply` (single source of truth)."""
+    K/V (B, T, Hkv, Dh) — the fused-prefill building block: one forward
+    fills a whole prompt's KV instead of T decode steps.  Shares the trunk
+    with :func:`transformer_apply` (single source of truth)."""
     return _forward(params, tokens, n_heads, n_layers, compute_dtype,
-                    attention_fn, collect_kv=True)
+                    attention_fn, collect_kv=True, n_kv_heads=n_kv_heads)
 
 
 def make_moe_transformer(vocab: int = 32000, d_model: int = 512,

@@ -55,10 +55,13 @@ def _slot_count(page_size: int, hd: int, itemsize: int) -> int:
 def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
                        o_ref, k_buf, v_buf, sem, *, page_size: int,
                        max_pages: int, n_heads: int, head_dim: int,
-                       sm_scale: float, precision, nbuf: int):
+                       n_kv_heads: int, sm_scale: float, precision,
+                       nbuf: int):
     lane = pl.program_id(0)
     length = lengths_ref[lane]                    # tokens visible (incl. current)
     h, d, hd = n_heads, head_dim, n_heads * head_dim
+    hkv, hd_kv = n_kv_heads, n_kv_heads * head_dim
+    g = h // hkv                                  # GQA group size (1 = MHA)
 
     q = q_ref[0].astype(jnp.float32) * sm_scale    # (1, H*D)
     # loop-invariant head selectors (hoisted out of the page loop by the
@@ -70,6 +73,15 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
     blk_t = jax.lax.broadcasted_iota(jnp.int32, (h, hd), 1) // d
     row_t = jax.lax.broadcasted_iota(jnp.int32, (h, hd), 0)
     sel_t = (blk_t == row_t).astype(jnp.float32)   # (H, H*D)
+    if g > 1:
+        # GQA: expansion one-hot (Hkv*D, H*D) broadcasting each KV head's
+        # D-block across its g query heads (exact: one 1.0 per column).
+        # Pages stage and DMA in the COMPACT Hkv form — the bandwidth win —
+        # and expand on the fly in VMEM via one matmul per page.
+        r_i = jax.lax.broadcasted_iota(jnp.int32, (hd_kv, hd), 0)
+        c_i = jax.lax.broadcasted_iota(jnp.int32, (hd_kv, hd), 1)
+        expand = jnp.logical_and(r_i // d == (c_i // d) // g,
+                                 r_i % d == c_i % d).astype(jnp.float32)
     # score dot: operands are pool/query data — precision follows the pool
     # dtype (bf16 data carries no extra bits for HIGHEST to preserve).
     # selector-expansion dots: operands are f32 softmax intermediates
@@ -129,8 +141,11 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
                 start_dma(j + nbuf - 1,
                           jax.lax.rem(j + nbuf - 1, nbuf))
 
-            k = k_buf[slot].astype(jnp.float32)   # (S, H*D)
+            k = k_buf[slot].astype(jnp.float32)   # (S, Hkv*D)
             v = v_buf[slot].astype(jnp.float32)
+            if g > 1:
+                k = dot2(k, expand)               # (S, H*D) GQA broadcast
+                v = dot2(v, expand)
             s = dot2(k * q, sel)                  # (S, H) per-head scores
             pos = j * page_size + jax.lax.broadcasted_iota(
                 jnp.int32, (page_size, h), 0)
@@ -159,16 +174,19 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
     b, h, d = q.shape
-    n_pages, page_size = k_pool.shape[0], k_pool.shape[1]
+    n_pages, page_size, hkv = (k_pool.shape[0], k_pool.shape[1],
+                               k_pool.shape[2])
+    if h % hkv:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hkv}")
     max_pages = tables.shape[1]
-    # stage pages as (S, H*D) rows: contiguous (free) reshape, keeps every
-    # in-kernel dot 2D (see module docstring)
+    # stage pages as (S, Hkv*D) rows: contiguous (free) reshape, keeps
+    # every in-kernel dot 2D (see module docstring)
     # rank-3 (B, 1, H*D) so the (1, 1, H*D) block's last two dims equal the
     # array dims exactly (the Pallas TPU block tiling rule)
     q2 = q.reshape(b, 1, h * d)
-    kp2 = k_pool.reshape(n_pages, page_size, h * d)
-    vp2 = v_pool.reshape(n_pages, page_size, h * d)
-    nbuf = _slot_count(page_size, h * d, jnp.dtype(k_pool.dtype).itemsize)
+    kp2 = k_pool.reshape(n_pages, page_size, hkv * d)
+    vp2 = v_pool.reshape(n_pages, page_size, hkv * d)
+    nbuf = _slot_count(page_size, hkv * d, jnp.dtype(k_pool.dtype).itemsize)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                 # tables (flat), lengths
         grid=(b,),
@@ -179,8 +197,8 @@ def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
         ],
         out_specs=pl.BlockSpec((1, 1, h * d), lambda lane, *_: (lane, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((nbuf, page_size, h * d), k_pool.dtype),
-            pltpu.VMEM((nbuf, page_size, h * d), v_pool.dtype),
+            pltpu.VMEM((nbuf, page_size, hkv * d), k_pool.dtype),
+            pltpu.VMEM((nbuf, page_size, hkv * d), v_pool.dtype),
             pltpu.SemaphoreType.DMA((nbuf, 2)),               # [slot][k/v]
         ],
     )
@@ -192,8 +210,8 @@ def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
                  else jax.lax.Precision.DEFAULT)
     kernel = functools.partial(
         _paged_attn_kernel, page_size=page_size, max_pages=max_pages,
-        n_heads=h, head_dim=d, sm_scale=1.0 / np.sqrt(d),
-        precision=precision, nbuf=nbuf)
+        n_heads=h, head_dim=d, n_kv_heads=hkv,
+        sm_scale=1.0 / np.sqrt(d), precision=precision, nbuf=nbuf)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -205,13 +223,15 @@ def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
 
 def paged_decode_attention(q, k_pool, v_pool, tables, lengths,
                            interpret: bool | None = None):
-    """Ragged paged decode attention.
+    """Ragged paged decode attention (MHA or grouped-query).
 
-    q (B, H, D) — one query token per lane;
-    k_pool/v_pool (P, S, H, D) — one layer's page pool;
+    q (B, Hq, D) — one query token per lane;
+    k_pool/v_pool (P, S, Hkv, D) — one layer's page pool (``Hkv < Hq``
+    selects GQA: pages DMA in the compact Hkv form and broadcast to the
+    query heads inside the kernel, so KV bandwidth shrinks by Hq/Hkv);
     tables (B, MP) int32 page ids (padded rows point at the scratch page 0);
     lengths (B,) int32 — the current position per lane (inclusive visibility).
-    Returns (B, H, D).
+    Returns (B, Hq, D).
     """
     if interpret is None:
         from tpulab.tpu.platform import is_tpu
