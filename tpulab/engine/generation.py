@@ -29,7 +29,8 @@ class GenerationEngine:
     def __init__(self, params: Any, n_heads: int, n_layers: int,
                  max_len: int = 1024, max_sessions: int = 2,
                  compute_dtype=None, device=None,
-                 n_kv_heads: Optional[int] = None):
+                 n_kv_heads: Optional[int] = None,
+                 rope_theta: Optional[float] = None):
         import jax
         import jax.numpy as jnp
         from tpulab.models.transformer import (init_kv_cache,
@@ -50,10 +51,12 @@ class GenerationEngine:
 
         self._decode = jax.jit(partial(
             transformer_decode_step, n_heads=n_heads, n_layers=n_layers,
-            compute_dtype=compute_dtype, n_kv_heads=self.n_kv_heads))
+            compute_dtype=compute_dtype, n_kv_heads=self.n_kv_heads,
+            rope_theta=rope_theta))
         self._generate = make_generate_fn(self.params, n_heads, n_layers,
                                           max_len, compute_dtype,
-                                          n_kv_heads=self.n_kv_heads)
+                                          n_kv_heads=self.n_kv_heads,
+                                          rope_theta=rope_theta)
         # cache slots hold the compact n_kv_heads form under GQA: the
         # generation analog of execution-context pooling
         self._init_cache = partial(init_kv_cache, 1, max_len, n_layers,

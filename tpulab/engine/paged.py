@@ -149,7 +149,8 @@ def _kernel_compiles(n_heads: int, head_dim: int, page_size: int,
 def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
                       active, n_heads: int, n_layers: int,
                       compute_dtype, use_kernel: bool = False,
-                      n_kv_heads: Optional[int] = None):
+                      n_kv_heads: Optional[int] = None,
+                      rope_theta: Optional[float] = None):
     """One batched decode tick over the paged pool.
 
     Shapes: tables (B, MP) int32 page ids (padded rows repeat page 0),
@@ -160,7 +161,8 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
     """
     import jax
     import jax.numpy as jnp
-    from tpulab.models.transformer import _rmsnorm, repeat_kv, split_qkv
+    from tpulab.models.transformer import (_dense_ffn, _lm_head, _rmsnorm,
+                                           apply_rope, repeat_kv, split_qkv)
 
     n_kv = n_kv_heads or n_heads
     b = tokens.shape[0]
@@ -179,6 +181,10 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
         h = _rmsnorm(x, p["ln1"]["scale"])
         qkv = h @ p["wqkv"].astype(compute_dtype)
         q, knew, vnew = split_qkv(qkv, b, 1, n_heads, n_kv, head_dim)
+        if rope_theta:
+            # per-lane positions: each lane decodes at its own length
+            q = apply_rope(q, lengths[:, None], rope_theta)
+            knew = apply_rope(knew, lengths[:, None], rope_theta)
         knew = knew[:, 0].astype(k_pool.dtype)      # (B, Hkv, D)
         vnew = vnew[:, 0].astype(v_pool.dtype)
         # scatter the new K/V into their pages; inactive/padded lanes are
@@ -214,11 +220,10 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
                                                                    d_model)
         x = x + attn @ p["wo"].astype(compute_dtype)
         h2 = _rmsnorm(x, p["ln2"]["scale"])
-        ff = jax.nn.gelu(h2 @ p["w1"].astype(compute_dtype))
-        x = x + ff @ p["w2"].astype(compute_dtype)
+        x = x + _dense_ffn(p, h2, compute_dtype).astype(x.dtype)
 
     x = _rmsnorm(x, params["final_norm"]["scale"])
-    logits = x[:, 0].astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    logits = _lm_head(params, x[:, 0])
     # inactive lanes emit neutral logits (argmax 0) — callers mask on active
     logits = jnp.where(active[:, None], logits, 0.0)
     return logits, k_pool, v_pool
@@ -226,7 +231,8 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
 
 def paged_prefill(params, k_pool, v_pool, tables, tokens, valid_len,
                   n_heads: int, n_layers: int, compute_dtype,
-                  n_kv_heads: Optional[int] = None):
+                  n_kv_heads: Optional[int] = None,
+                  rope_theta: Optional[float] = None):
     """Fused prefill: ONE causal forward over the (padded) prompt, with each
     layer's K/V scattered straight into the lane's pages.
 
@@ -243,7 +249,8 @@ def paged_prefill(params, k_pool, v_pool, tables, tokens, valid_len,
     t_pad = tokens.shape[1]
     logits, kvs = transformer_forward_collect_kv(
         params, tokens, n_heads=n_heads, n_layers=n_layers,
-        compute_dtype=compute_dtype, n_kv_heads=n_kv_heads)
+        compute_dtype=compute_dtype, n_kv_heads=n_kv_heads,
+        rope_theta=rope_theta)
     pos = jnp.arange(t_pad)
     valid = pos < valid_len
     page_idx = jnp.where(valid, tables[pos // page_size], 0)  # scratch if pad
@@ -322,7 +329,8 @@ class ContinuousBatcher:
                  max_len: int = 256, page_size: int = 16,
                  n_pages: int = 0, compute_dtype=None, device=None,
                  use_kernel: Optional[bool] = None,
-                 n_kv_heads: Optional[int] = None):
+                 n_kv_heads: Optional[int] = None,
+                 rope_theta: Optional[float] = None):
         import jax
         import jax.numpy as jnp
 
@@ -354,12 +362,13 @@ class ContinuousBatcher:
         self._step = jax.jit(
             partial(paged_decode_step, n_heads=n_heads, n_layers=n_layers,
                     compute_dtype=compute_dtype, use_kernel=self.use_kernel,
-                    n_kv_heads=n_kv),
+                    n_kv_heads=n_kv, rope_theta=rope_theta),
             donate_argnums=(1, 2))
         # fused prefill, compiled per prompt-length bucket (powers of two)
         self._prefill = jax.jit(
             partial(paged_prefill, n_heads=n_heads, n_layers=n_layers,
-                    compute_dtype=compute_dtype, n_kv_heads=n_kv),
+                    compute_dtype=compute_dtype, n_kv_heads=n_kv,
+                    rope_theta=rope_theta),
             donate_argnums=(1, 2))
         self._queue: List[_PagedRequest] = []
         self._requests: Dict[Future, _PagedRequest] = {}

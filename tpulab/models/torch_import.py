@@ -80,3 +80,65 @@ def make_resnet_from_torch(state_dict_or_path, depth: int = 50,
     model = make_resnet(depth=depth, **make_kwargs)
     model.params = resnet_params_from_torch(state_dict, depth)
     return model
+
+
+# --------------------------------------------------------------------------
+# Llama-family import (HF LlamaForCausalLM state_dict -> tpulab transformer)
+# --------------------------------------------------------------------------
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        # .float() first: torch bf16 tensors (how Llama checkpoints ship)
+        # have no direct .numpy() path
+        return t.detach().cpu().float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def llama_params_from_torch(state_dict: Mapping[str, Any],
+                            n_layers: int = 0) -> Dict[str, Any]:
+    """HF ``LlamaForCausalLM`` state_dict -> tpulab transformer params.
+
+    Maps the Llama architecture onto this framework's transformer family
+    (RMSNorm + RoPE + GQA + SwiGLU, all of which the family implements
+    natively): q/k/v projections fuse into ``wqkv`` (torch Linear weights
+    are (out, in) — transposed to the (in, out) matmul layout used here),
+    gate/up/down become w1/w3/w2, and an untied ``lm_head`` is imported
+    when present (tied models fall back to the embedding transpose).
+
+    Serve the result with ``n_kv_heads`` and ``rope_theta`` from the HF
+    config (e.g. ``ContinuousBatcher(params, n_heads=cfg.num_attention_heads,
+    n_kv_heads=cfg.num_key_value_heads, rope_theta=cfg.rope_theta, ...)``).
+    """
+    sd = state_dict
+    ckpt_layers = len({k.split(".")[2] for k in sd
+                       if k.startswith("model.layers.")})
+    if n_layers == 0:
+        n_layers = ckpt_layers
+    elif n_layers != ckpt_layers:
+        raise ValueError(f"n_layers={n_layers} but the checkpoint has "
+                         f"{ckpt_layers} decoder layers")
+    params: Dict[str, Any] = {
+        "embed": _np(sd["model.embed_tokens.weight"]),
+        "final_norm": {"scale": _np(sd["model.norm.weight"])},
+    }
+    for i in range(n_layers):
+        pre = f"model.layers.{i}"
+        wq = _np(sd[f"{pre}.self_attn.q_proj.weight"]).T     # (in, Hq*D)
+        wk = _np(sd[f"{pre}.self_attn.k_proj.weight"]).T     # (in, Hkv*D)
+        wv = _np(sd[f"{pre}.self_attn.v_proj.weight"]).T
+        params[f"layer{i}"] = {
+            "ln1": {"scale": _np(sd[f"{pre}.input_layernorm.weight"])},
+            "ln2": {"scale": _np(
+                sd[f"{pre}.post_attention_layernorm.weight"])},
+            "wqkv": np.concatenate([wq, wk, wv], axis=1),
+            "wo": _np(sd[f"{pre}.self_attn.o_proj.weight"]).T,
+            "w1": _np(sd[f"{pre}.mlp.gate_proj.weight"]).T,
+            "w3": _np(sd[f"{pre}.mlp.up_proj.weight"]).T,
+            "w2": _np(sd[f"{pre}.mlp.down_proj.weight"]).T,
+        }
+    if "lm_head.weight" in sd:
+        params["lm_head"] = _np(sd["lm_head.weight"]).T
+    # jnp leaves: numpy leaves can't be indexed by traced token ids
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(jnp.asarray, params)

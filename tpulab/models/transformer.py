@@ -20,11 +20,15 @@ import numpy as np
 def init_transformer_params(vocab: int = 32000, d_model: int = 512,
                             n_heads: int = 8, n_layers: int = 6,
                             d_ff: int = 2048, seed: int = 0,
-                            n_kv_heads: Optional[int] = None) -> Dict[str, Any]:
+                            n_kv_heads: Optional[int] = None,
+                            ffn: str = "gelu",
+                            tie_embeddings: bool = True) -> Dict[str, Any]:
     """``n_kv_heads < n_heads`` selects grouped-query attention (GQA;
     ``n_kv_heads=1`` is MQA): K/V projections shrink to ``n_kv_heads``
     heads, cutting KV-cache HBM and decode bandwidth by the group factor.
-    Default (None) is standard multi-head attention."""
+    Default (None) is standard multi-head attention.  ``ffn="swiglu"``
+    adds the w3 gate projection (Llama family); ``tie_embeddings=False``
+    adds an untied ``lm_head``."""
     n_kv = n_kv_heads or n_heads
     if n_heads % n_kv:
         raise ValueError(f"n_heads {n_heads} not divisible by "
@@ -38,16 +42,25 @@ def init_transformer_params(vocab: int = 32000, d_model: int = 512,
         "final_norm": {"scale": jnp.ones((d_model,))},
     }
     for i in range(n_layers):
+        lkeys = iter(jax.random.split(next(keys), 8))
         params[f"layer{i}"] = {
             "ln1": {"scale": jnp.ones((d_model,))},
             "ln2": {"scale": jnp.ones((d_model,))},
             "wqkv": jax.random.normal(
-                next(keys),
+                next(lkeys),
                 (d_model, (n_heads + 2 * n_kv) * head_dim)) * s,
-            "wo": jax.random.normal(next(keys), (d_model, d_model)) * s,
-            "w1": jax.random.normal(next(keys), (d_model, d_ff)) * s,
-            "w2": jax.random.normal(next(keys), (d_ff, d_model)) * s,
+            "wo": jax.random.normal(next(lkeys), (d_model, d_model)) * s,
+            "w1": jax.random.normal(next(lkeys), (d_model, d_ff)) * s,
+            "w2": jax.random.normal(next(lkeys), (d_ff, d_model)) * s,
         }
+        if ffn == "swiglu":
+            params[f"layer{i}"]["w3"] = jax.random.normal(
+                next(lkeys), (d_model, d_ff)) * s
+        elif ffn != "gelu":
+            raise ValueError(f"unknown ffn {ffn!r}")
+    if not tie_embeddings:
+        params["lm_head"] = jax.random.normal(next(keys),
+                                              (d_model, vocab)) * s
     return params
 
 
@@ -67,6 +80,24 @@ def repeat_kv(kv, n_heads):
     if hkv == n_heads:
         return kv
     return jnp.repeat(kv, n_heads // hkv, axis=-2)
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary position embedding (HF Llama rotate-half convention).
+
+    x (..., T, H, D); positions (..., T) int — broadcast against x's batch
+    dims.  K is rotated BEFORE cache/pool writes, so cached keys are
+    position-baked and attention needs no further rotation.
+    """
+    d = x.shape[-1]
+    half = d // 2
+    inv = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = positions.astype(jnp.float32)[..., None] * inv   # (..., T, half)
+    cos = jnp.concatenate([jnp.cos(ang)] * 2, -1)[..., None, :]  # (.., T, 1, D)
+    sin = jnp.concatenate([jnp.sin(ang)] * 2, -1)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rot = jnp.concatenate([-x2, x1], axis=-1)
+    return (x.astype(jnp.float32) * cos + rot * sin).astype(x.dtype)
 
 
 def _rmsnorm(x, scale):
@@ -91,29 +122,50 @@ def causal_attention(q, k, v):
 
 
 def _dense_ffn(p, h, compute_dtype):
-    """Default FFN block (w1/gelu/w2)."""
+    """Default FFN block: SwiGLU when the layer has a ``w3`` gate
+    projection (the Llama family), else w1/gelu/w2."""
+    if "w3" in p:
+        return (jax.nn.silu(h @ p["w1"].astype(compute_dtype))
+                * (h @ p["w3"].astype(compute_dtype))) \
+            @ p["w2"].astype(compute_dtype)
     return jax.nn.gelu(h @ p["w1"].astype(compute_dtype)) \
         @ p["w2"].astype(compute_dtype)
 
 
+def _lm_head(params, x):
+    """Final projection: untied ``lm_head`` when present, else tied to the
+    embedding matrix."""
+    if "lm_head" in params:
+        return x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+    return x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+
+
 def _forward(params, tokens, n_heads, n_layers, compute_dtype, attention_fn,
              collect_kv: bool = False, ffn_fn=_dense_ffn,
-             n_kv_heads: Optional[int] = None):
+             n_kv_heads: Optional[int] = None,
+             rope_theta: Optional[float] = None):
     """Shared transformer trunk: (B, T) tokens -> (logits, kvs or None).
     ``ffn_fn(layer_params, h, compute_dtype)`` swaps the FFN (dense / MoE).
     ``collect_kv`` returns the UNexpanded (B, T, Hkv, D) heads — the
-    compact form KV caches/pools store under GQA."""
+    compact form KV caches/pools store under GQA.  ``rope_theta`` enables
+    rotary embeddings at absolute positions 0..T-1 (collected K is rotated,
+    matching the decode paths' position-baked caches).  Under sequence
+    parallelism pass pre-roped inputs or keep rope off here."""
     n_kv = n_kv_heads or n_heads
     emb = params["embed"].astype(compute_dtype)
     x = emb[tokens]
     b, t, d_model = x.shape
     head_dim = d_model // n_heads
     kvs = [] if collect_kv else None
+    positions = jnp.arange(t) if rope_theta else None
     for i in range(n_layers):
         p = params[f"layer{i}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
         qkv = h @ p["wqkv"].astype(compute_dtype)
         q, k, v = split_qkv(qkv, b, t, n_heads, n_kv, head_dim)
+        if rope_theta:
+            q = apply_rope(q, positions, rope_theta)
+            k = apply_rope(k, positions, rope_theta)
         if collect_kv:
             kvs.append((k, v))
         attn = attention_fn(q, repeat_kv(k, n_heads),
@@ -122,20 +174,20 @@ def _forward(params, tokens, n_heads, n_layers, compute_dtype, attention_fn,
         h = _rmsnorm(x, p["ln2"]["scale"])
         x = x + ffn_fn(p, h, compute_dtype).astype(x.dtype)
     x = _rmsnorm(x, params["final_norm"]["scale"])
-    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
-    return logits, kvs
+    return _lm_head(params, x), kvs
 
 
 def transformer_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
                       n_heads: int = 8, n_layers: int = 6,
                       compute_dtype=jnp.bfloat16,
                       attention_fn: Callable = causal_attention,
-                      n_kv_heads: Optional[int] = None
+                      n_kv_heads: Optional[int] = None,
+                      rope_theta: Optional[float] = None
                       ) -> Dict[str, jnp.ndarray]:
     """tokens (B, T) int32 -> logits (B, T, vocab) f32."""
     logits, _ = _forward(params, inputs["tokens"], n_heads, n_layers,
                          compute_dtype, attention_fn,
-                         n_kv_heads=n_kv_heads)
+                         n_kv_heads=n_kv_heads, rope_theta=rope_theta)
     return {"logits": logits}
 
 
@@ -179,7 +231,8 @@ def transformer_decode_step(params: Dict[str, Any], cache: Dict[str, Any],
                             tokens: jnp.ndarray, pos: jnp.ndarray,
                             n_heads: int = 8, n_layers: int = 6,
                             compute_dtype=jnp.bfloat16,
-                            n_kv_heads: Optional[int] = None):
+                            n_kv_heads: Optional[int] = None,
+                            rope_theta: Optional[float] = None):
     """One decode step: tokens (B,) int32 at position ``pos`` (scalar int32).
 
     Returns (logits (B, vocab) f32, updated cache).  Attention runs against
@@ -192,12 +245,16 @@ def transformer_decode_step(params: Dict[str, Any], cache: Dict[str, Any],
     b, _, d_model = x.shape
     head_dim = d_model // n_heads
     max_len = next(iter(cache.values()))["k"].shape[1]
+    positions = jnp.asarray(pos)[None] if rope_theta else None  # T=1
     new_cache = {}
     for i in range(n_layers):
         p = params[f"layer{i}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
         qkv = h @ p["wqkv"].astype(compute_dtype)
         q, k, v = split_qkv(qkv, b, 1, n_heads, n_kv, head_dim)
+        if rope_theta:
+            q = apply_rope(q, positions, rope_theta)
+            k = apply_rope(k, positions, rope_theta)
         ck = jax.lax.dynamic_update_slice(
             cache[f"layer{i}"]["k"], k.astype(cache[f"layer{i}"]["k"].dtype),
             (0, pos, 0, 0))
@@ -222,17 +279,16 @@ def transformer_decode_step(params: Dict[str, Any], cache: Dict[str, Any],
                           cv.astype(compute_dtype)).reshape(b, 1, d_model)
         x = x + attn @ p["wo"].astype(compute_dtype)
         h2 = _rmsnorm(x, p["ln2"]["scale"])
-        ff = jax.nn.gelu(h2 @ p["w1"].astype(compute_dtype))
-        x = x + ff @ p["w2"].astype(compute_dtype)
+        x = x + _dense_ffn(p, h2, compute_dtype).astype(x.dtype)
     x = _rmsnorm(x, params["final_norm"]["scale"])
-    logits = (x[:, 0].astype(jnp.float32)
-              @ params["embed"].T.astype(jnp.float32))
+    logits = _lm_head(params, x[:, 0])
     return logits, new_cache
 
 
 def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
                      max_len: int, compute_dtype=jnp.bfloat16,
-                     n_kv_heads: Optional[int] = None):
+                     n_kv_heads: Optional[int] = None,
+                     rope_theta: Optional[float] = None):
     """Jitted greedy generation: (prompt (B, T_p), steps) -> (B, steps).
 
     Prefill replays the prompt through scanned decode steps to warm the
@@ -255,7 +311,7 @@ def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
             cache, _ = carry
             logits, cache = transformer_decode_step(
                 params, cache, prompt[:, i], i, n_heads, n_layers,
-                compute_dtype, n_kv_heads=n_kv)
+                compute_dtype, n_kv_heads=n_kv, rope_theta=rope_theta)
             return (cache, logits), None
 
         (cache, logits), _ = jax.lax.scan(
@@ -266,7 +322,7 @@ def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
             cache, tok = carry
             logits, cache = transformer_decode_step(
                 params, cache, tok, t_p + i, n_heads, n_layers,
-                compute_dtype, n_kv_heads=n_kv)
+                compute_dtype, n_kv_heads=n_kv, rope_theta=rope_theta)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (cache, nxt), nxt
 
@@ -283,13 +339,15 @@ def transformer_forward_collect_kv(params: Dict[str, Any],
                                    n_heads: int = 8, n_layers: int = 6,
                                    compute_dtype=jnp.bfloat16,
                                    attention_fn: Callable = causal_attention,
-                                   n_kv_heads: Optional[int] = None):
+                                   n_kv_heads: Optional[int] = None,
+                                   rope_theta: Optional[float] = None):
     """Causal forward over (B, T) tokens that also returns each layer's
     K/V (B, T, Hkv, Dh) — the fused-prefill building block: one forward
     fills a whole prompt's KV instead of T decode steps.  Shares the trunk
     with :func:`transformer_apply` (single source of truth)."""
     return _forward(params, tokens, n_heads, n_layers, compute_dtype,
-                    attention_fn, collect_kv=True, n_kv_heads=n_kv_heads)
+                    attention_fn, collect_kv=True, n_kv_heads=n_kv_heads,
+                    rope_theta=rope_theta)
 
 
 def make_moe_transformer(vocab: int = 32000, d_model: int = 512,

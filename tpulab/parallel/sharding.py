@@ -32,12 +32,15 @@ def transformer_param_shardings(params: Dict[str, Any], mesh: Mesh,
                                 model_axis: str = "model") -> Dict[str, Any]:
     """Megatron-style TP rules for tpulab.models.transformer params:
 
-    - ``wqkv``/``w1``: column-parallel (shard output dim over model axis)
+    - ``wqkv``/``w1``/``w3``/``lm_head``: column-parallel (shard output
+      dim over the model axis; w3 is the SwiGLU gate, lm_head's sharded
+      output dim is the vocab — matching the tied ``embed.T`` layout)
     - ``wo``/``w2``: row-parallel (shard input dim; XLA inserts the psum)
     - embeddings: shard vocab dim; norms replicated
     """
     def rule(path: str):
-        if path.endswith("wqkv") or path.endswith("w1"):
+        if (path.endswith("wqkv") or path.endswith("w1")
+                or path.endswith("w3") or path.endswith("lm_head")):
             return P(None, model_axis)
         if path.endswith("wo") or path.endswith("w2"):
             return P(model_axis, None)
