@@ -1,0 +1,150 @@
+"""Speculative decoding: draft-model proposal + single-pass target verify.
+
+Beyond the reference's scope (trtlab predates LLM serving), squarely in
+this framework's serving mandate: decode is HBM-bandwidth bound (one
+weight read per token), so a small draft model proposes ``k`` tokens and
+the target model verifies all of them in ONE chunked forward
+(:func:`tpulab.models.transformer.transformer_chunk_step`) — ``a+1``
+tokens emitted per target weight-read instead of 1, where ``a`` is the
+accepted prefix length.
+
+Greedy acceptance rule: accept draft tokens while they equal the target's
+own greedy choice, then emit the target's correction (or bonus) token.
+The output is therefore EXACTLY the target model's greedy sequence —
+speculation changes latency, never content.  Both KV caches tolerate
+rejected-token writes because positions only advance: stale slots are
+overwritten before any later step can attend to them (see
+transformer_chunk_step's docstring).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, List, Optional
+
+import numpy as np
+
+
+class SpeculativeGenerator:
+    """Greedy speculative decoding over two transformer-family models."""
+
+    def __init__(self, target_params: Any, draft_params: Any, *,
+                 n_heads: int, n_layers: int,
+                 draft_n_heads: Optional[int] = None,
+                 draft_n_layers: Optional[int] = None,
+                 k: int = 4, max_len: int = 1024,
+                 compute_dtype=None, device=None,
+                 n_kv_heads: Optional[int] = None,
+                 draft_n_kv_heads: Optional[int] = None,
+                 rope_theta: Optional[float] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from tpulab.models.transformer import (init_kv_cache,
+                                               transformer_chunk_step,
+                                               transformer_decode_step)
+        from tpulab.tpu import platform as plat
+
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = k
+        self.max_len = max_len
+        self.device = device if device is not None else plat.local_device(0)
+        cdt = compute_dtype or jnp.float32
+        self._jnp = jnp
+        self.target_params = jax.device_put(target_params, self.device)
+        self.draft_params = jax.device_put(draft_params, self.device)
+
+        dh = draft_n_heads or n_heads
+        dl = draft_n_layers or n_layers
+        t_kv = n_kv_heads or n_heads
+        # same-arch draft (draft_n_heads omitted) inherits the target's KV
+        # head count; an explicit draft arch defaults to MHA
+        d_kv = draft_n_kv_heads or (t_kv if draft_n_heads is None else dh)
+        t_dim = target_params["embed"].shape[1] // n_heads
+        d_dim = draft_params["embed"].shape[1] // dh
+        self._t_cache = partial(init_kv_cache, 1, max_len, n_layers, t_kv,
+                                t_dim, cdt)
+        self._d_cache = partial(init_kv_cache, 1, max_len, dl, d_kv,
+                                d_dim, cdt)
+        # target: one chunked forward verifies a whole proposal window
+        # (M = k+1 fixed -> one compiled program; prefill buckets by pow2)
+        self._verify = jax.jit(partial(
+            transformer_chunk_step, n_heads=n_heads, n_layers=n_layers,
+            compute_dtype=cdt, n_kv_heads=n_kv_heads, rope_theta=rope_theta))
+        # draft: chunked prefill + k single-token steps under one jitted scan
+        self._d_prefill = jax.jit(partial(
+            transformer_chunk_step, n_heads=dh, n_layers=dl,
+            compute_dtype=cdt, n_kv_heads=d_kv,
+            rope_theta=rope_theta))
+        d_step = partial(transformer_decode_step, n_heads=dh, n_layers=dl,
+                         compute_dtype=cdt, n_kv_heads=d_kv,
+                         rope_theta=rope_theta)
+
+        @jax.jit
+        def draft_propose(params, cache, tok, pos0):
+            # k+1 iterations: the extra one FEEDS drafts[k-1] so its K/V
+            # lands in the draft cache (a fully-accepted round advances
+            # past position pos0+k — without this the slot would stay a
+            # zero hole every later draft query attends).  Its output is
+            # discarded; on partial acceptance the extra writes are stale
+            # but positions only advance, so they are overwritten before
+            # they become visible.
+            def body(carry, i):
+                cache, tok = carry
+                logits, cache = d_step(params, cache, tok, pos0 + i)
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                return (cache, nxt), nxt[0]
+            (cache, _), toks = jax.lax.scan(body, (cache, tok),
+                                            jnp.arange(self.k + 1))
+            return toks[:self.k], cache
+        self._propose = draft_propose
+
+    # -- public --------------------------------------------------------------
+    def generate(self, prompt, steps: int) -> List[int]:
+        """Greedy-decode ``steps`` tokens; returns exactly the target
+        model's greedy continuation.  ``rounds``/``accepted`` telemetry
+        from the last call is exposed on the instance."""
+        jnp = self._jnp
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        t_p = prompt.shape[0]
+        if max(t_p + steps + self.k + 1,
+               1 << (t_p - 1).bit_length()) > self.max_len:
+            raise ValueError("prompt+steps+k exceeds max_len")
+
+        t_cache, d_cache = self._t_cache(), self._d_cache()
+        # prefill both models with one chunked forward each (pow2 bucket)
+        t_pad = 1 << (t_p - 1).bit_length()
+        padded = np.zeros((1, t_pad), np.int32)
+        padded[0, :t_p] = prompt
+        tl, t_cache = self._verify(self.target_params, t_cache,
+                                   jnp.asarray(padded), jnp.int32(0))
+        _, d_cache = self._d_prefill(self.draft_params, d_cache,
+                                     jnp.asarray(padded), jnp.int32(0))
+        cur = int(np.asarray(tl)[0, t_p - 1].argmax())
+        out = [cur]
+        p = t_p                     # tokens FED to the target so far
+        self.rounds = 0
+        self.accepted = 0
+        while len(out) < steps:
+            drafts, d_cache = self._propose(
+                self.draft_params, d_cache,
+                jnp.asarray([cur], jnp.int32), jnp.int32(p))
+            drafts = np.asarray(drafts, np.int32)          # (k,)
+            chunk = np.concatenate([[cur], drafts])[None, :]  # (1, k+1)
+            logits, t_cache = self._verify(
+                self.target_params, t_cache, jnp.asarray(chunk),
+                jnp.int32(p))
+            greedy = np.asarray(logits)[0].argmax(-1).astype(np.int32)
+            # accept the agreeing prefix; token a's correction (or the
+            # bonus after a full match) is always emitted
+            a = 0
+            while a < self.k and drafts[a] == greedy[a]:
+                a += 1
+            emitted = list(drafts[:a]) + [int(greedy[a])]
+            out.extend(emitted)
+            cur = int(greedy[a])
+            p += a + 1
+            self.rounds += 1
+            self.accepted += a
+        return out[:steps]

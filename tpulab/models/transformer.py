@@ -237,52 +237,72 @@ def transformer_decode_step(params: Dict[str, Any], cache: Dict[str, Any],
 
     Returns (logits (B, vocab) f32, updated cache).  Attention runs against
     cache[: pos+1] via position masking — static shapes, scan/jit friendly
-    (no data-dependent Python control flow).
+    (no data-dependent Python control flow).  Exactly the M=1 case of
+    :func:`transformer_chunk_step` (single source of truth for the
+    cache-attention math).
+    """
+    logits, new_cache = transformer_chunk_step(
+        params, cache, tokens[:, None], jnp.asarray(pos),
+        n_heads=n_heads, n_layers=n_layers, compute_dtype=compute_dtype,
+        n_kv_heads=n_kv_heads, rope_theta=rope_theta)
+    return logits[:, 0], new_cache
+
+
+def transformer_chunk_step(params: Dict[str, Any], cache: Dict[str, Any],
+                           tokens: jnp.ndarray, pos0: jnp.ndarray,
+                           n_heads: int = 8, n_layers: int = 6,
+                           compute_dtype=jnp.bfloat16,
+                           n_kv_heads: Optional[int] = None,
+                           rope_theta: Optional[float] = None):
+    """Multi-token decode: process M new tokens (B, M) starting at position
+    ``pos0`` (scalar int32) against the KV cache in ONE forward.
+
+    Attention per chunk token m: all cache positions < pos0 + causal within
+    the chunk.  Returns (logits (B, M, vocab) f32, updated cache).  This is
+    the chunked-prefill AND speculative-verify primitive: a chunk of draft
+    proposals verifies in one pass, and cache entries written past an
+    eventual acceptance point are harmless — positions only advance, so
+    stale slots are overwritten before they can ever be attended to.
     """
     n_kv = n_kv_heads or n_heads
     emb = params["embed"].astype(compute_dtype)
-    x = emb[tokens][:, None, :]                     # (B, 1, D)
-    b, _, d_model = x.shape
+    x = emb[tokens]                                  # (B, M, D)
+    b, m, d_model = x.shape
     head_dim = d_model // n_heads
     max_len = next(iter(cache.values()))["k"].shape[1]
-    positions = jnp.asarray(pos)[None] if rope_theta else None  # T=1
+    positions = pos0 + jnp.arange(m) if rope_theta else None
     new_cache = {}
     for i in range(n_layers):
         p = params[f"layer{i}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
         qkv = h @ p["wqkv"].astype(compute_dtype)
-        q, k, v = split_qkv(qkv, b, 1, n_heads, n_kv, head_dim)
+        q, k, v = split_qkv(qkv, b, m, n_heads, n_kv, head_dim)
         if rope_theta:
             q = apply_rope(q, positions, rope_theta)
             k = apply_rope(k, positions, rope_theta)
         ck = jax.lax.dynamic_update_slice(
             cache[f"layer{i}"]["k"], k.astype(cache[f"layer{i}"]["k"].dtype),
-            (0, pos, 0, 0))
+            (0, pos0, 0, 0))
         cv = jax.lax.dynamic_update_slice(
             cache[f"layer{i}"]["v"], v.astype(cache[f"layer{i}"]["v"].dtype),
-            (0, pos, 0, 0))
+            (0, pos0, 0, 0))
         new_cache[f"layer{i}"] = {"k": ck, "v": cv}
-        # attend against positions <= pos (masked full-ring attention:
-        # static shapes; masked lanes cost FLOPs but keep XLA happy).
-        # GQA: group the QUERY heads (B, 1, Hkv, G, D) against the compact
-        # cache — no (B, T, Hq, D) expansion materializes, so the cache
-        # read stays at the Hkv bandwidth GQA exists for.
+        # mask: chunk token m attends cache position j iff j <= pos0 + m
         g = n_heads // n_kv
-        qg = q.reshape(b, 1, n_kv, g, head_dim)
+        qg = q.reshape(b, m, n_kv, g, head_dim)
         scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
                             ck.astype(jnp.float32)) / np.sqrt(head_dim)
         k_pos = jnp.arange(max_len)
-        scores = jnp.where(
-            k_pos[None, None, None, None, :] <= pos, scores, -1e30)
+        vis = k_pos[None, :] <= (pos0 + jnp.arange(m))[:, None]   # (M, T)
+        scores = jnp.where(vis[None, None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(compute_dtype)
         attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs,
-                          cv.astype(compute_dtype)).reshape(b, 1, d_model)
+                          cv.astype(compute_dtype)).reshape(b, m, d_model)
         x = x + attn @ p["wo"].astype(compute_dtype)
         h2 = _rmsnorm(x, p["ln2"]["scale"])
         x = x + _dense_ffn(p, h2, compute_dtype).astype(x.dtype)
     x = _rmsnorm(x, params["final_norm"]["scale"])
-    logits = _lm_head(params, x[:, 0])
-    return logits, new_cache
+    return _lm_head(params, x), new_cache
 
 
 def make_generate_fn(params: Dict[str, Any], n_heads: int, n_layers: int,
