@@ -56,6 +56,7 @@ class PagedKVPool:
         # page 0 is RESERVED as scratch: inactive/padded lanes scatter their
         # (masked-out) K/V there, so it must never hold live data
         self._free: List[int] = list(range(1, n_pages))
+        self._refs: Dict[int, int] = {}  # live page -> refcount
         self._lock = threading.Lock()
 
     # K/V buffers rotate through XLA donation; the setters keep the device
@@ -91,6 +92,7 @@ class PagedKVPool:
         self.v = jax.device_put(jnp.zeros(self._shape, self._dtype), self.device)
         with self._lock:
             self._free = list(range(1, self.n_pages))  # page 0 stays scratch
+            self._refs.clear()
 
     def close(self) -> None:
         """Eagerly free the page stores' HBM."""
@@ -107,11 +109,34 @@ class PagedKVPool:
 
     def allocate_page(self) -> Optional[int]:
         with self._lock:
-            return self._free.pop() if self._free else None
+            if not self._free:
+                return None
+            page = self._free.pop()
+            self._refs[page] = 1
+            return page
+
+    def add_ref(self, page: int) -> None:
+        """Share an allocated page (prefix caching): one extra
+        release_pages() is now required before the page frees."""
+        with self._lock:
+            if page not in self._refs:
+                raise ValueError(f"add_ref on non-live page {page}")
+            self._refs[page] += 1
 
     def release_pages(self, pages: List[int]) -> None:
+        """Drop one reference per page; pages free when the count hits 0
+        (pages from pre-refcount callers behave exactly as before: one
+        allocate, one release)."""
         with self._lock:
-            self._free.extend(p for p in pages if p)  # 0/None never re-enter
+            for p in pages:
+                if not p:
+                    continue  # 0/None never re-enter
+                n = self._refs.get(p, 1) - 1
+                if n <= 0:
+                    self._refs.pop(p, None)
+                    self._free.append(p)
+                else:
+                    self._refs[p] = n
 
 
 @functools.lru_cache(maxsize=None)
@@ -146,6 +171,34 @@ def _kernel_compiles(n_heads: int, head_dim: int, page_size: int,
         return False
 
 
+def _gather_attend(q, k_layer, v_layer, tables, qpos, compute_dtype):
+    """Dense-gather paged attention (the XLA fallback math, single source
+    of truth for decode ticks and extend/chunked prefill).
+
+    q (B, M, H, D) query tokens; k_layer/v_layer (P, S, Hkv, D) one
+    layer's pools; tables (B, MP) page ids; qpos (B, M) global position
+    of each query token (visibility: context j attends iff j <= qpos).
+    Returns (B, M, H*D).
+    """
+    import jax
+    import jax.numpy as jnp
+    from tpulab.models.transformer import repeat_kv
+
+    b, m, h, d = q.shape
+    mp = tables.shape[1]
+    page_size = k_layer.shape[1]
+    k_ctx = repeat_kv(k_layer[tables].reshape(b, mp * page_size, -1, d), h)
+    v_ctx = repeat_kv(v_layer[tables].reshape(b, mp * page_size, -1, d), h)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k_ctx.astype(jnp.float32)) / np.sqrt(d)
+    j = jnp.arange(mp * page_size)
+    mask = j[None, None, :] <= qpos[:, :, None]          # (B, M, K)
+    scores = jnp.where(mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(compute_dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs,
+                      v_ctx.astype(compute_dtype)).reshape(b, m, h * d)
+
+
 def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
                       active, n_heads: int, n_layers: int,
                       compute_dtype, use_kernel: bool = False,
@@ -159,15 +212,13 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
     Under GQA (``n_kv_heads < n_heads``) the pools hold ``n_kv_heads``
     heads per slot.
     """
-    import jax
     import jax.numpy as jnp
     from tpulab.models.transformer import (_dense_ffn, _lm_head, _rmsnorm,
-                                           apply_rope, repeat_kv, split_qkv)
+                                           apply_rope, split_qkv)
 
     n_kv = n_kv_heads or n_heads
     b = tokens.shape[0]
     page_size = k_pool.shape[2]
-    mp = tables.shape[1]
     emb = params["embed"].astype(compute_dtype)
     x = emb[tokens][:, None, :]
     d_model = x.shape[-1]
@@ -203,21 +254,8 @@ def paged_decode_step(params, k_pool, v_pool, tables, lengths, tokens,
             ).astype(compute_dtype).reshape(b, 1, d_model)
         else:
             # XLA fallback: gather pages densely then mask
-            k_ctx = repeat_kv(
-                k_pool[layer][tables].reshape(b, mp * page_size, n_kv,
-                                              head_dim), n_heads)
-            v_ctx = repeat_kv(
-                v_pool[layer][tables].reshape(b, mp * page_size, n_kv,
-                                              head_dim), n_heads)
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                                k_ctx.astype(jnp.float32)) / np.sqrt(head_dim)
-            pos = jnp.arange(mp * page_size)
-            mask = pos[None, None, None, :] <= lengths[:, None, None, None]
-            scores = jnp.where(mask, scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(compute_dtype)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                              v_ctx.astype(compute_dtype)).reshape(b, 1,
-                                                                   d_model)
+            attn = _gather_attend(q, k_pool[layer], v_pool[layer], tables,
+                                  lengths[:, None], compute_dtype)
         x = x + attn @ p["wo"].astype(compute_dtype)
         h2 = _rmsnorm(x, p["ln2"]["scale"])
         x = x + _dense_ffn(p, h2, compute_dtype).astype(x.dtype)
@@ -262,6 +300,173 @@ def paged_prefill(params, k_pool, v_pool, tables, tokens, valid_len,
             v[0].astype(v_pool.dtype))
     last = logits[0, valid_len - 1]
     return last, k_pool, v_pool
+
+
+def paged_extend(params, k_pool, v_pool, tables, tokens, start, valid_total,
+                 n_heads: int, n_layers: int, compute_dtype,
+                 n_kv_heads: Optional[int] = None,
+                 rope_theta: Optional[float] = None):
+    """Chunked/tail prefill against EXISTING paged context.
+
+    One fused forward over M tail tokens (positions ``start ..
+    start+M-1``) for a single lane whose positions ``[0, start)`` are
+    already resident in the pool (prefix-cache hits or earlier chunks of a
+    chunked prefill).  Per layer the tail K/V scatter into their pages
+    first, then attention gathers the lane's WHOLE block table — the
+    gather-after-scatter sees cached prefix and tail together, so the mask
+    is just global causality (tail token m attends position j iff
+    ``j <= start+m``).
+
+    tokens (1, M_pad) int32 (padded tail arbitrary); start scalar int32
+    (page-aligned: the tail must never write into a shared prefix page);
+    valid_total scalar int32 = true total length (prompt so far + tail);
+    tables (MP,) page ids covering all of it.  Returns (logits of the last
+    valid token (vocab,), k_pool, v_pool) — pools donated by the caller.
+    """
+    import jax.numpy as jnp
+    from tpulab.models.transformer import (_dense_ffn, _lm_head, _rmsnorm,
+                                           apply_rope, split_qkv)
+
+    n_kv = n_kv_heads or n_heads
+    page_size = k_pool.shape[2]
+    m_pad = tokens.shape[1]
+    emb = params["embed"].astype(compute_dtype)
+    x = emb[tokens]                                   # (1, M_pad, D)
+    d_model = x.shape[-1]
+    head_dim = d_model // n_heads
+    pos = start + jnp.arange(m_pad)                   # global positions
+    valid = pos < valid_total
+    page_idx = jnp.where(valid, tables[pos // page_size], 0)  # pad -> scratch
+    slot_idx = jnp.where(valid, pos % page_size, 0)
+
+    for layer in range(n_layers):
+        p = params[f"layer{layer}"]
+        h = _rmsnorm(x, p["ln1"]["scale"])
+        qkv = h @ p["wqkv"].astype(compute_dtype)
+        q, knew, vnew = split_qkv(qkv, 1, m_pad, n_heads, n_kv, head_dim)
+        if rope_theta:
+            q = apply_rope(q, pos, rope_theta)
+            knew = apply_rope(knew, pos, rope_theta)
+        k_pool = k_pool.at[layer, page_idx, slot_idx].set(
+            knew[0].astype(k_pool.dtype))
+        v_pool = v_pool.at[layer, page_idx, slot_idx].set(
+            vnew[0].astype(v_pool.dtype))
+        # gather-after-scatter: context = cached prefix + this tail
+        attn = _gather_attend(q, k_pool[layer], v_pool[layer], tables[None],
+                              pos[None], compute_dtype)
+        x = x + attn @ p["wo"].astype(compute_dtype)
+        h2 = _rmsnorm(x, p["ln2"]["scale"])
+        x = x + _dense_ffn(p, h2, compute_dtype).astype(x.dtype)
+
+    # only the last valid token's logits are ever consumed — run the
+    # vocab-sized head over ONE row, not all M_pad rows
+    x_last = x[0, valid_total - 1 - start][None]      # (1, D)
+    x_last = _rmsnorm(x_last, params["final_norm"]["scale"])
+    last = _lm_head(params, x_last)[0]                # (vocab,)
+    return last, k_pool, v_pool
+
+
+class PrefixCache:
+    """Prompt prefix cache over the paged pool (full-page granularity).
+
+    Maps a digest of the token prefix ``prompt[:(i+1)*S]`` to the page
+    holding that S-token span's K/V.  A hit lets a new request *share* the
+    cached pages (``PagedKVPool.add_ref``) and prefill only the tail via
+    :func:`paged_extend` — the paged-serving time-to-first-token
+    optimization for shared system prompts / few-shot preambles.
+
+    Safety: only FULL prompt pages enter the cache, and a request's write
+    region (tail prefill + decode appends) always sits at page boundaries
+    at-or-after its shared prefix — shared pages are read-only by
+    construction, so no copy-on-write is needed.  The last prompt token is
+    never served from cache (its logits seed generation), which the
+    lookup guarantees by capping reuse at ``(t-1) // S`` pages.
+
+    LRU: entries hold one pool reference each; under pool pressure the
+    batcher evicts from the cold end.  Single-threaded by design — only
+    the scheduler thread touches it (documented invariant).
+    """
+
+    def __init__(self, pool: PagedKVPool):
+        from collections import OrderedDict
+        self._pool = pool
+        self._entries: "OrderedDict[bytes, int]" = OrderedDict()
+        self.hits = 0       # pages served from cache
+        self.misses = 0     # full prompt pages computed fresh
+
+    @staticmethod
+    def _digests(prompt: np.ndarray, page_size: int, n_pages: int):
+        import hashlib
+        # incremental chain: extend one page per step and snapshot — O(t)
+        # total bytes hashed (a from-scratch prefix hash per page is O(t^2))
+        out = []
+        raw = np.ascontiguousarray(prompt, np.int32)
+        h = hashlib.blake2b(digest_size=16)
+        for i in range(n_pages):
+            h.update(raw[i * page_size:(i + 1) * page_size].tobytes())
+            out.append(h.copy().digest())
+        return out
+
+    def lookup(self, prompt: np.ndarray, page_size: int):
+        """Longest cached full-page prefix of ``prompt``.
+
+        Returns (shared_pages, digests) where ``shared_pages`` are
+        ref-bumped for the caller (caller owns one release each) and
+        ``digests`` covers every full prompt page (for insert later).
+        Hit/miss accounting is the CALLER's job (count_lookup) once the
+        prefill actually proceeds — a page-pressure retry re-runs lookup
+        and must not double-count.
+        """
+        t = len(prompt)
+        cacheable = max(0, (t - 1) // page_size)  # last token never cached
+        digests = self._digests(prompt, page_size,
+                                t // page_size)
+        shared: List[int] = []
+        for i in range(cacheable):
+            page = self._entries.get(digests[i])
+            if page is None:
+                break
+            self._entries.move_to_end(digests[i])
+            self._pool.add_ref(page)
+            shared.append(page)
+        return shared, digests
+
+    def count_lookup(self, n_shared: int, n_full_pages: int) -> None:
+        """Record one *successful* lookup's hit/miss stats."""
+        self.hits += n_shared
+        self.misses += max(0, n_full_pages - n_shared)
+
+    def insert(self, digests: List[bytes], pages: List[int]) -> None:
+        """Publish a prefilled request's full prompt pages (one extra pool
+        ref each, owned by the cache).  Digest collisions with existing
+        entries keep the incumbent (both pages hold identical K/V)."""
+        for dig, page in zip(digests, pages):
+            if dig in self._entries:
+                self._entries.move_to_end(dig)
+                continue
+            self._pool.add_ref(page)
+            self._entries[dig] = page
+
+    def evict_one(self) -> bool:
+        """Drop the coldest entry (its pool ref); True if something fell."""
+        if not self._entries:
+            return False
+        _, page = self._entries.popitem(last=False)
+        self._pool.release_pages([page])
+        return True
+
+    def clear(self) -> None:
+        while self.evict_one():
+            pass
+
+    def drop_all(self) -> None:
+        """Forget every entry WITHOUT touching the pool — for use after
+        ``PagedKVPool.reset()`` already rebuilt the free list (releasing
+        into a reset pool would double-free)."""
+        self._entries.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)
 
 
 class SamplingParams:
@@ -330,7 +535,9 @@ class ContinuousBatcher:
                  n_pages: int = 0, compute_dtype=None, device=None,
                  use_kernel: Optional[bool] = None,
                  n_kv_heads: Optional[int] = None,
-                 rope_theta: Optional[float] = None):
+                 rope_theta: Optional[float] = None,
+                 prefix_cache: bool = False,
+                 prefill_chunk: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
@@ -370,6 +577,21 @@ class ContinuousBatcher:
                     compute_dtype=compute_dtype, n_kv_heads=n_kv,
                     rope_theta=rope_theta),
             donate_argnums=(1, 2))
+        # tail/chunk prefill against existing pool context (prefix-cache
+        # hits, chunked long prompts) — compiled per tail-length bucket
+        self._extend = jax.jit(
+            partial(paged_extend, n_heads=n_heads, n_layers=n_layers,
+                    compute_dtype=compute_dtype, n_kv_heads=n_kv,
+                    rope_theta=rope_theta),
+            donate_argnums=(1, 2))
+        self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
+        if prefill_chunk is not None:
+            if prefill_chunk < page_size:
+                raise ValueError("prefill_chunk must be >= page_size")
+            # chunk starts must stay page-aligned (a chunk's successor
+            # writes from a page boundary)
+            prefill_chunk -= prefill_chunk % page_size
+        self.prefill_chunk = prefill_chunk
         self._queue: List[_PagedRequest] = []
         self._requests: Dict[Future, _PagedRequest] = {}
         self._active: List[Optional[_PagedRequest]] = [None] * lanes
@@ -419,6 +641,8 @@ class ContinuousBatcher:
             self._shutdown = True
             self._cv.notify()
         self._thread.join(timeout=30)
+        if not self._thread.is_alive() and self.prefix_cache is not None:
+            self.prefix_cache.clear()  # release the cache's page refs
         if self._owns_pool and not self._thread.is_alive():
             self.pool.close()  # free the page stores' HBM eagerly
 
@@ -428,11 +652,20 @@ class ContinuousBatcher:
             return sum(r is not None for r in self._active)
 
     # -- scheduler ----------------------------------------------------------
+    def _alloc_page(self) -> Optional[int]:
+        """Pool page, evicting cold prefix-cache entries under pressure —
+        live requests always outrank cached prefixes."""
+        page = self.pool.allocate_page()
+        while (page is None and self.prefix_cache is not None
+               and self.prefix_cache.evict_one()):
+            page = self.pool.allocate_page()
+        return page
+
     def _admit_locked(self) -> None:
         for lane in range(self.lanes):
             if self._active[lane] is None and self._queue:
                 # needs at least one page to start
-                page = self.pool.allocate_page()
+                page = self._alloc_page()
                 if page is None:
                     return
                 req = self._queue.pop(0)
@@ -496,18 +729,32 @@ class ContinuousBatcher:
                             self._requests.pop(req.future, None)
                             self._active[lane] = None
                 # donated pools may be gone after a failed step — rebuild
+                if self.prefix_cache is not None:
+                    self.prefix_cache.drop_all()  # entries died with the pool
                 self.pool.reset()
 
     def _do_prefill(self, req: _PagedRequest, jnp) -> bool:
         """Fused prompt prefill: one compiled forward (per length bucket)
-        fills the whole prompt's KV pages.  Returns False (retry later) when
-        the pool can't yet supply the prompt's pages."""
+        fills the whole prompt's KV pages.  With a prefix cache, shared
+        full-page prefixes are reused and only the tail runs (paged_extend);
+        with ``prefill_chunk`` long tails run in page-aligned chunks.
+        Returns False (retry later) when the pool can't yet supply the
+        prompt's pages."""
         if req.cancelled or req.length != 0:  # swept / already started
             return False
         t = len(req.pending_prompt)
+        prompt = np.asarray(req.pending_prompt, np.int32)
+        shared: List[int] = []
+        digests: List[bytes] = []
+        if self.prefix_cache is not None:
+            shared, digests = self.prefix_cache.lookup(prompt, self.page_size)
+        # page layout: shared prefix pages first, then private pages (the
+        # admission page + extras) for the tail/write region
+        private = req.pages
+        req.pages = shared + private
         needed = (t + self.page_size - 1) // self.page_size
         while len(req.pages) < needed:
-            page = self.pool.allocate_page()
+            page = self._alloc_page()
             if page is None:
                 # page pressure: release partial holdings before retrying —
                 # two starved prefills must not hold-and-wait each other
@@ -515,19 +762,42 @@ class ContinuousBatcher:
                 req.pages = []
                 return False
             req.pages.append(page)
-        t_pad = 1 << (t - 1).bit_length()  # pow2 bucket -> small jit cache
-        tokens = np.zeros((1, t_pad), np.int32)
-        tokens[0, :t] = req.pending_prompt
+        start = len(shared) * self.page_size
         tables = np.zeros((self.max_pages,), np.int32)
         tables[:len(req.pages)] = req.pages
-        last_logits, self.pool.k, self.pool.v = self._prefill(
-            self.params, self.pool.k, self.pool.v, jnp.asarray(tables),
-            jnp.asarray(tokens), jnp.int32(t))
+        tables_j = jnp.asarray(tables)
+        if start == 0 and (self.prefill_chunk is None
+                           or t <= self.prefill_chunk):
+            t_pad = 1 << (t - 1).bit_length()  # pow2 bucket: small jit cache
+            tokens = np.zeros((1, t_pad), np.int32)
+            tokens[0, :t] = prompt
+            last_logits, self.pool.k, self.pool.v = self._prefill(
+                self.params, self.pool.k, self.pool.v, tables_j,
+                jnp.asarray(tokens), jnp.int32(t))
+        else:
+            # tail (and/or chunked) prefill against resident context
+            chunk = self.prefill_chunk or (t - start)
+            last_logits = None
+            while start < t:
+                m = min(chunk, t - start)
+                m_pad = 1 << (m - 1).bit_length()
+                tokens = np.zeros((1, m_pad), np.int32)
+                tokens[0, :m] = prompt[start:start + m]
+                last_logits, self.pool.k, self.pool.v = self._extend(
+                    self.params, self.pool.k, self.pool.v, tables_j,
+                    jnp.asarray(tokens), jnp.int32(start),
+                    jnp.int32(start + m))
+                start += m
         req.length = t
         req.pending_prompt = []
         tok = req.sampling.pick(np.asarray(last_logits))
         req.tokens_out.append(tok)
         self._emit(req, tok, 0)
+        if self.prefix_cache is not None:
+            self.prefix_cache.count_lookup(len(shared), len(digests))
+            # publish this prompt's full pages (immutable from here on:
+            # decode writes only at positions >= t)
+            self.prefix_cache.insert(digests, req.pages[:len(digests)])
         return True
 
     @staticmethod
@@ -550,7 +820,7 @@ class ContinuousBatcher:
                 continue
             # grow the block table when entering a fresh page
             if req.length // self.page_size >= len(req.pages):
-                page = self.pool.allocate_page()
+                page = self._alloc_page()
                 if page is None:
                     continue  # pool pressure: lane skips this tick
                 req.pages.append(page)
