@@ -239,3 +239,21 @@ def test_image_client_preprocessing(tmp_path):
     assert u8.shape == (224, 224, 3) and u8.dtype == np.uint8
     f32 = load_image(str(p), size=224, dtype=np.float32)
     assert f32.dtype == np.float32 and abs(float(f32.mean())) < 3.0
+
+
+def test_notebook_llm_serving():
+    """The LLM-serving tour runs end to end (continuous batching, prefix
+    cache, streaming, speculative decoding)."""
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "TPULAB_FORCE_CPU": "1", "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from tpulab.tpu.platform import force_cpu; force_cpu(1);"
+         "import runpy; runpy.run_path("
+         f"'{REPO}/notebooks/llm_serving.py', run_name='__main__')"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "streamed as decoded" in out.stdout
+    assert "page hits" in out.stdout
+    assert out.stdout.strip().endswith("done")

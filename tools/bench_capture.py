@@ -60,9 +60,12 @@ def main() -> int:
     ap.add_argument("--attempt-deadline-s", type=float, default=2100.0)
     ap.add_argument("--backoff-s", type=float, default=600.0)
     ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--out", default="", help="output JSON path (default "
+                    "docs/BENCH_EARLY_r{round}.json)")
     args = ap.parse_args()
 
-    out_path = os.path.join(REPO, "docs", f"BENCH_EARLY_r{args.round:02d}.json")
+    out_path = args.out or os.path.join(
+        REPO, "docs", f"BENCH_EARLY_r{args.round:02d}.json")
     t_end = time.monotonic() + args.max_hours * 3600.0
     n = 0
     while time.monotonic() < t_end:
