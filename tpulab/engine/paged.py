@@ -500,10 +500,11 @@ class SamplingParams:
 class _PagedRequest:
     __slots__ = ("prompt", "steps", "future", "tokens_out", "pages",
                  "length", "pending_prompt", "on_token", "cancelled",
-                 "sampling")
+                 "sampling", "priority", "resumed", "admit_seq")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
-                 sampling: Optional[SamplingParams] = None):
+                 sampling: Optional[SamplingParams] = None,
+                 priority: int = 0):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.steps = steps
         self.future: Future = Future()
@@ -514,6 +515,10 @@ class _PagedRequest:
         self.on_token = on_token
         self.cancelled = False
         self.sampling = sampling or SamplingParams()
+        self.priority = priority
+        self.resumed = False     # preempted mid-decode; resume skips the
+        #                          prefill pick (its token was already emitted)
+        self.admit_seq = -1      # admission order (preemption tie-break)
 
 
 class ContinuousBatcher:
@@ -595,6 +600,8 @@ class ContinuousBatcher:
         self._queue: List[_PagedRequest] = []
         self._requests: Dict[Future, _PagedRequest] = {}
         self._active: List[Optional[_PagedRequest]] = [None] * lanes
+        self._admit_counter = 0
+        self.preemptions = 0
         self._cv = threading.Condition()
         self._shutdown = False
         self._thread = threading.Thread(target=self._run, name="cbatch",
@@ -603,10 +610,16 @@ class ContinuousBatcher:
 
     # -- public -------------------------------------------------------------
     def submit(self, prompt, steps: int, on_token=None,
-               sampling: Optional[SamplingParams] = None) -> Future:
+               sampling: Optional[SamplingParams] = None,
+               priority: int = 0) -> Future:
         """``on_token(token, index)`` (optional) streams tokens as they
         decode — the hook the Generate RPC rides for paged serving.
-        ``sampling`` selects the token policy (default greedy)."""
+        ``sampling`` selects the token policy (default greedy).
+        ``priority`` orders admission (higher first; FIFO within a class)
+        and arms preemption: a queued request strictly outranking an active
+        one evicts it — the victim's pages free immediately and it resumes
+        later by re-prefilling prompt+generated (exact-token resume; with a
+        prefix cache the recompute mostly hits cached pages)."""
         n_prompt = len(np.asarray(prompt).reshape(-1))
         if n_prompt == 0:
             raise ValueError("empty prompt")
@@ -615,11 +628,11 @@ class ContinuousBatcher:
         if n_prompt + steps > self.max_len:
             raise ValueError(f"prompt+steps exceeds max_len {self.max_len}")
         req = _PagedRequest(prompt, steps, on_token=on_token,
-                            sampling=sampling)
+                            sampling=sampling, priority=priority)
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("ContinuousBatcher is shut down")
-            self._queue.append(req)
+            self._enqueue_locked(req, front_of_class=False)
             self._requests[req.future] = req
             self._cv.notify()
         return req.future
@@ -652,6 +665,19 @@ class ContinuousBatcher:
             return sum(r is not None for r in self._active)
 
     # -- scheduler ----------------------------------------------------------
+    def _enqueue_locked(self, req: _PagedRequest,
+                        front_of_class: bool) -> None:
+        """Insert by priority (higher first, FIFO within a class);
+        ``front_of_class`` puts the request ahead of its equals (preempted
+        victims resume before new same-priority arrivals)."""
+        i = 0
+        for i, q in enumerate(self._queue):
+            if (q.priority < req.priority
+                    or (front_of_class and q.priority == req.priority)):
+                self._queue.insert(i, req)
+                return
+        self._queue.append(req)
+
     def _alloc_page(self) -> Optional[int]:
         """Pool page, evicting cold prefix-cache entries under pressure —
         live requests always outrank cached prefixes."""
@@ -661,16 +687,62 @@ class ContinuousBatcher:
             page = self.pool.allocate_page()
         return page
 
+    def _admit_to_lane_locked(self, lane: int) -> bool:
+        """Admit the queue head into a free lane (needs at least one page
+        to start); False when the pool can't supply it."""
+        page = self._alloc_page()
+        if page is None:
+            return False
+        req = self._queue.pop(0)
+        req.pages.append(page)
+        req.admit_seq = self._admit_counter
+        self._admit_counter += 1
+        self._active[lane] = req
+        return True
+
     def _admit_locked(self) -> None:
         for lane in range(self.lanes):
             if self._active[lane] is None and self._queue:
-                # needs at least one page to start
-                page = self._alloc_page()
-                if page is None:
-                    return
-                req = self._queue.pop(0)
-                req.pages.append(page)
-                self._active[lane] = req
+                if not self._admit_to_lane_locked(lane):
+                    break
+        # preemption: while the queue head strictly outranks the weakest
+        # active request (priority tie-break: most recently admitted falls
+        # first — least progress lost), evict it and admit the head.
+        # Zero-page lanes (page-starved prefills) are skipped: evicting
+        # them frees nothing and they already yield every tick.
+        while self._queue:
+            head = self._queue[0]
+            victims = [(req.priority, -req.admit_seq, lane)
+                       for lane, req in enumerate(self._active)
+                       if req is not None and req.priority < head.priority
+                       and req.pages]
+            if not victims:
+                return
+            _, _, lane = min(victims)
+            self._preempt_locked(lane)
+            if not self._admit_to_lane_locked(lane):
+                return  # unreachable: the victim's pages just freed
+
+    def _preempt_locked(self, lane: int) -> None:
+        """Evict the lane's request: free its pages now, re-queue it for an
+        exact-token resume (re-prefill of prompt+generated; no sampling
+        PRNG draws are consumed on resume, so seeded sequences are
+        unchanged by preemption)."""
+        req = self._active[lane]
+        self.pool.release_pages(req.pages)
+        req.pages = []
+        if req.tokens_out:
+            # feed everything but the last emitted token; the resume
+            # prefill's logits are discarded (that pick already happened)
+            req.pending_prompt = (list(req.prompt)
+                                  + list(req.tokens_out[:-1]))
+            req.resumed = True
+        else:
+            req.pending_prompt = list(req.prompt)
+        req.length = 0
+        self._active[lane] = None
+        self._enqueue_locked(req, front_of_class=True)
+        self.preemptions += 1
 
     def _run(self) -> None:
         import jax.numpy as jnp
@@ -790,13 +862,23 @@ class ContinuousBatcher:
                 start += m
         req.length = t
         req.pending_prompt = []
-        tok = req.sampling.pick(np.asarray(last_logits))
-        req.tokens_out.append(tok)
-        self._emit(req, tok, 0)
-        if self.prefix_cache is not None:
+        was_resumed = req.resumed
+        if was_resumed:
+            # preemption resume: the fed tail ends at tokens_out[-2]; the
+            # last emitted token was picked before eviction — discard these
+            # logits, consume no PRNG state, just continue decoding
+            req.resumed = False
+        else:
+            tok = req.sampling.pick(np.asarray(last_logits))
+            req.tokens_out.append(tok)
+            self._emit(req, tok, 0)
+        if self.prefix_cache is not None and not was_resumed:
+            # count each logical request once (resume prefills re-walk
+            # already-counted pages) and publish only first-prefill pages:
+            # full prompt pages are immutable from here on (decode writes
+            # at positions >= t), while a resume's tail pages hold
+            # generated tokens unique to this request — not worth caching
             self.prefix_cache.count_lookup(len(shared), len(digests))
-            # publish this prompt's full pages (immutable from here on:
-            # decode writes only at positions >= t)
             self.prefix_cache.insert(digests, req.pages[:len(digests)])
         return True
 

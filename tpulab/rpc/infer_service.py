@@ -364,6 +364,16 @@ class GenerateContext(StreamingContext):
         if getattr(engine, "continuous_batching", False):  # explicit marker
             self._run_paged(engine, request)
             return
+        if request.temperature > 0.0 or request.priority != 0:
+            # the dense session engine is greedy/FIFO only — reject rather
+            # than silently returning greedy tokens for a sampled request
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT,
+                message=f"model {request.model_name!r} is served by a dense "
+                        "session engine: sampling (temperature/top_k/seed) "
+                        "and priority require a continuous-batching "
+                        "backend")))
+            return
         try:
             with engine.start_session(
                     timeout=self.SESSION_LEASE_TIMEOUT_S) as session:
@@ -397,8 +407,16 @@ class GenerateContext(StreamingContext):
 
         fut = None
         try:
+            sampling = None
+            if request.temperature > 0.0:
+                from tpulab.engine.paged import SamplingParams
+                sampling = SamplingParams(
+                    temperature=request.temperature, top_k=request.top_k,
+                    seed=request.seed if request.HasField("seed") else None)
             fut = engine.submit(np.asarray(request.prompt, np.int32),
-                                request.steps, on_token=on_token)
+                                request.steps, on_token=on_token,
+                                sampling=sampling,
+                                priority=request.priority)
             deadline = _time.monotonic() + self.SESSION_LEASE_TIMEOUT_S
             while True:
                 try:
@@ -435,7 +453,9 @@ class GenerateStreamClient:
         self._manager = manager
         self.model_name = model_name
 
-    def generate(self, prompt, steps: int, timeout: float = 300.0):
+    def generate(self, prompt, steps: int, timeout: float = 300.0,
+                 priority: int = 0, temperature: float = 0.0,
+                 top_k: int = 0, seed: Optional[int] = None):
         import queue as _q
         out: "_q.Queue" = _q.Queue()
         stream = ClientStreaming(
@@ -444,9 +464,13 @@ class GenerateStreamClient:
         # a dead stream must wake the consumer promptly, not via timeout
         _STREAM_DEAD = object()
         stream.done().add_done_callback(lambda _f: out.put(_STREAM_DEAD))
-        stream.write(pb.GenerateRequest(
+        req = pb.GenerateRequest(
             model_name=self.model_name,
-            prompt=list(np.asarray(prompt, np.int32)), steps=steps))
+            prompt=list(np.asarray(prompt, np.int32)), steps=steps,
+            priority=priority, temperature=temperature, top_k=top_k)
+        if seed is not None:
+            req.seed = seed
+        stream.write(req)
         stream.writes_done()
         finished = False
         try:
