@@ -478,3 +478,28 @@ def test_generate_rpc_dense_rejects_sampling(lm):
     finally:
         remote.close()
         mgr.shutdown()
+
+
+def test_generate_rpc_negative_temperature_rejected(lm):
+    """temperature < 0 is INVALID_ARGUMENT on any backend (mirrors the
+    local SamplingParams contract), never silently greedy."""
+    import tpulab
+    from tpulab.models.mnist import make_mnist
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=1, max_len=32,
+                           page_size=8, compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": cb})
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        with pytest.raises(RuntimeError, match="temperature"):
+            list(GenerateStreamClient(remote, "lm").generate(
+                np.zeros(4, np.int32), 2, temperature=-0.5))
+    finally:
+        remote.close()
+        mgr.shutdown()
+        cb.shutdown()

@@ -19,6 +19,8 @@ first-class here because multi-chip scaling shapes the core design:
 - :mod:`pipeline` — GPipe-style pipeline parallelism (microbatch streaming
   over ppermute)
 - :mod:`multihost` — jax.distributed bootstrap, global meshes, barriers
+- :mod:`checkpoint` — orbax train-state checkpoint/resume (sharded,
+  async, cross-mesh restore)
 """
 
 from tpulab.parallel.mesh import make_mesh, default_mesh
@@ -29,10 +31,12 @@ from tpulab.parallel.sharding import (
     transformer_param_shardings,
 )
 from tpulab.parallel.dispatch import MultiDeviceDispatcher
+from tpulab.parallel.checkpoint import TrainCheckpointer, abstract_like
 
 __all__ = [
     "make_mesh", "default_mesh",
     "named_sharding", "replicate", "shard_batch",
     "transformer_param_shardings",
     "MultiDeviceDispatcher",
+    "TrainCheckpointer", "abstract_like",
 ]

@@ -1,0 +1,104 @@
+"""Distributed train-state checkpoint/resume (orbax over sharded pytrees).
+
+The reference's checkpoint story is engine artifacts only — serialized
+TensorRT plan files built offline (SURVEY §5: reference
+examples/ONNX/resnet50/build.py:33-70; tpulab mirrors those with
+``engine/runtime.py`` save/load_engine).  The TPU build also carries a
+*training* step (:mod:`tpulab.parallel.training`), so it needs what the
+reference never did: runtime checkpoint/resume of sharded train state
+across process restarts and mesh reshapes.
+
+TPU-first: orbax writes each shard from the device that owns it (no
+host gather), and restore takes an *abstract* target (shape/dtype/
+sharding) so state saved on one mesh restores onto another — XLA moves
+the bytes to the new layout.  Multi-host safe: orbax coordinates the
+write across processes; only process 0 finalizes the step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["TrainCheckpointer", "abstract_like"]
+
+
+def abstract_like(tree: Any, mesh=None, shardings=None) -> Any:
+    """Abstract restore target from a concrete (or abstract) pytree.
+
+    With ``shardings`` (a matching pytree of NamedSharding, e.g. from
+    :func:`tpulab.parallel.sharding.transformer_param_shardings`), the
+    restored arrays land directly in that layout — pass the NEW mesh's
+    shardings to reshape a checkpoint across topologies.  Without it,
+    each leaf keeps the sharding it carries (restore onto the same mesh).
+    """
+    import jax
+
+    def leaf(x, s=None):
+        if not hasattr(x, "shape"):
+            return x  # scalar metadata (step counters etc.): pass through
+        shard = s if s is not None else getattr(x, "sharding", None)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=shard)
+
+    if shardings is not None:
+        return jax.tree_util.tree_map(leaf, tree, shardings)
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+class TrainCheckpointer:
+    """Step-numbered sharded checkpoints with retention + resume-latest.
+
+    save(step, state) -> async shard write (device-local, no host gather);
+    restore(target=, step=None) -> state on the target's shardings;
+    latest_step() -> newest finalized step (None on a fresh directory).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._dir = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self._dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        """Write ``state`` (any pytree of arrays) as checkpoint ``step``.
+        Async by default — the train loop keeps stepping while shards
+        stream out; ``wait=True`` (or :meth:`wait`) blocks until durable."""
+        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, target: Any, step: Optional[int] = None) -> Any:
+        """Restore checkpoint ``step`` (default: latest) onto ``target`` —
+        an abstract pytree from :func:`abstract_like` (or concrete arrays,
+        whose shardings are reused).  Cross-mesh resume: build the target
+        with the new mesh's shardings and orbax+XLA reshard on load."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        abstract = abstract_like(target)
+        return self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -361,6 +361,13 @@ class GenerateContext(StreamingContext):
                 code=pb.UNKNOWN_MODEL,
                 message=f"no generation engine for {request.model_name!r}")))
             return
+        if request.temperature < 0.0:
+            # mirror SamplingParams' local contract instead of silently
+            # coercing a sign bug to greedy
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT,
+                message="temperature must be >= 0")))
+            return
         if getattr(engine, "continuous_batching", False):  # explicit marker
             self._run_paged(engine, request)
             return
