@@ -503,3 +503,43 @@ def test_generate_rpc_negative_temperature_rejected(lm):
         remote.close()
         mgr.shutdown()
         cb.shutdown()
+
+
+def test_kv_cache_quantization_fp8(lm):
+    """kv_dtype narrower than compute: pages store fp8 (4x less HBM than
+    f32), decode reads upcast, and the serving loop runs end to end with
+    logits tracking the full-precision pool closely."""
+    from tpulab.engine.paged import paged_decode_step
+
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=2, max_len=32,
+                           page_size=8, compute_dtype=jnp.float32,
+                           kv_dtype=jnp.float8_e4m3fn)
+    cb32 = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=2, max_len=32,
+                             page_size=8, compute_dtype=jnp.float32)
+    try:
+        assert cb.pool.dtype == jnp.float8_e4m3fn
+        assert cb.pool.hbm_bytes * 4 == cb32.pool.hbm_bytes
+        p = np.random.default_rng(2).integers(0, 64, (6,), np.int32)
+        out = cb.submit(p, 5).result(timeout=120)
+        assert len(out) == 5
+    finally:
+        cb.shutdown()
+        cb32.shutdown()
+
+    # numerics: one decode tick over identical KV content, fp8 vs f32 pool
+    rng = np.random.default_rng(0)
+    # pool shape: (n_layers, n_pages, page_size, n_heads, head_dim)
+    k32 = jnp.asarray(rng.uniform(-1, 1, (2, 4, 8, 2, 16)), jnp.float32)
+    v32 = jnp.asarray(rng.uniform(-1, 1, (2, 4, 8, 2, 16)), jnp.float32)
+    tables = jnp.asarray([[1, 2]], jnp.int32)
+    lengths = jnp.asarray([12], jnp.int32)
+    tokens = jnp.asarray([3], jnp.int32)
+    active = jnp.ones((1,), bool)
+    step = lambda k, v: paged_decode_step(
+        lm, k, v, tables, lengths, tokens, active, n_heads=2, n_layers=2,
+        compute_dtype=jnp.float32)[0]
+    l32 = np.asarray(step(k32, v32))
+    l8 = np.asarray(step(k32.astype(jnp.float8_e4m3fn),
+                         v32.astype(jnp.float8_e4m3fn)))
+    corr = np.corrcoef(l32.ravel(), l8.ravel())[0, 1]
+    assert corr > 0.98, corr

@@ -1,10 +1,14 @@
 """Parallel layer tests on the 8-virtual-device CPU mesh: meshes, shardings,
 ring/ulysses attention numerics, sharded train step, multi-device dispatch."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 from jax.sharding import PartitionSpec as P
 
 from tpulab.models.transformer import (causal_attention, dense_attention,
@@ -375,3 +379,64 @@ def test_checkpoint_cross_mesh_restore(tmp_path):
         got = ck2.restore(tgt)["x"]
     assert got.sharding.spec == named_sharding(mesh_b, "model", "data").spec
     np.testing.assert_array_equal(np.asarray(got), np.asarray(x))
+
+
+def test_checkpoint_resume_across_process_restart(tmp_path):
+    """Crash/resume across real process boundaries: part1 trains+saves and
+    exits; a fresh process resumes and must reproduce the uninterrupted
+    run's losses bit-for-bit."""
+    import subprocess
+    import sys
+    prog = """
+import sys
+import numpy as np
+from tpulab.tpu.platform import force_cpu
+force_cpu(4)
+import jax.numpy as jnp
+from tpulab.parallel import TrainCheckpointer, abstract_like, make_mesh
+from tpulab.parallel.training import make_sharded_train_step
+from tpulab.models.transformer import init_transformer_params, make_transformer
+
+mode, ckdir = sys.argv[1], sys.argv[2]
+mesh = make_mesh({"data": 2, "model": 2})
+params = init_transformer_params(vocab=32, d_model=32, n_heads=2,
+                                 n_layers=1, d_ff=64)
+model = make_transformer(vocab=32, d_model=32, n_heads=2, n_layers=1,
+                         d_ff=64, seq_len=8, compute_dtype=jnp.float32)
+step_fn, p = make_sharded_train_step(model.apply_fn, params, mesh,
+                                     learning_rate=1e-2)
+rng = np.random.default_rng(0)
+batch = {"tokens": jnp.asarray(rng.integers(0, 32, (4, 8)), jnp.int32),
+         "targets": jnp.asarray(rng.integers(0, 32, (4, 8)), jnp.int32)}
+with TrainCheckpointer(ckdir) as ck:
+    if mode == "full":
+        for i in range(4):
+            p, loss = step_fn(p, batch)
+            print(f"step {i} {float(loss):.8f}")
+    elif mode == "part1":
+        for i in range(2):
+            p, loss = step_fn(p, batch)
+            print(f"step {i} {float(loss):.8f}")
+        ck.save(1, {"step": 1, "params": p}, wait=True)
+    else:
+        s = ck.restore({"step": 0, "params": abstract_like(p)})
+        assert s["step"] == 1
+        p = s["params"]
+        for i in range(2, 4):
+            p, loss = step_fn(p, batch)
+            print(f"step {i} {float(loss):.8f}")
+"""
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin", "HOME": "/tmp",
+           "TPULAB_FORCE_CPU": "1"}
+
+    def run(mode, ckdir):
+        out = subprocess.run([sys.executable, "-c", prog, mode, str(ckdir)],
+                             capture_output=True, text=True, timeout=300,
+                             env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return [ln for ln in out.stdout.splitlines()
+                if ln.startswith("step")]
+
+    full = run("full", tmp_path / "a")
+    part = (run("part1", tmp_path / "b") + run("resume", tmp_path / "b"))
+    assert part == full and len(full) == 4

@@ -78,6 +78,12 @@ class PagedKVPool:
         self._v = self._alloc.replace(self._v_addr, value)
 
     @property
+    def dtype(self):
+        """Page storage dtype (may be narrower than the compute dtype —
+        KV-cache quantization)."""
+        return self._dtype
+
+    @property
     def hbm_bytes(self) -> int:
         """Live HBM of this pool's page stores (not allocator-wide: the
         allocator may be shared, e.g. a Runtime's)."""
@@ -142,10 +148,12 @@ class PagedKVPool:
 @functools.lru_cache(maxsize=None)
 def _kernel_compiles(n_heads: int, head_dim: int, page_size: int,
                      compute_dtype, device,
-                     n_kv_heads: Optional[int] = None) -> bool:
+                     n_kv_heads: Optional[int] = None,
+                     kv_dtype=None) -> bool:
     """One-shot probe: does the pallas ragged kernel compile+run on this
     device for this head geometry?  Cached per geometry; a Mosaic
-    rejection (tiling/VMEM limits) selects the XLA gather fallback."""
+    rejection (tiling/VMEM limits, unsupported pool dtype) selects the
+    XLA gather fallback."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -155,7 +163,7 @@ def _kernel_compiles(n_heads: int, head_dim: int, page_size: int,
                            device)
         kp = jax.device_put(
             jnp.zeros((2, page_size, n_kv_heads or n_heads, head_dim),
-                      compute_dtype),
+                      kv_dtype or compute_dtype),
             device)
         out = paged_decode_attention(
             q, kp, kp, np.zeros((1, 2), np.int32), np.zeros((1,), np.int32),
@@ -542,11 +550,18 @@ class ContinuousBatcher:
                  n_kv_heads: Optional[int] = None,
                  rope_theta: Optional[float] = None,
                  prefix_cache: bool = False,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 kv_dtype=None):
         import jax
         import jax.numpy as jnp
 
         compute_dtype = compute_dtype or jnp.bfloat16
+        # KV-cache quantization: pages may store a NARROWER dtype than the
+        # compute path (e.g. kv_dtype=jnp.float8_e4m3fn under bf16 compute
+        # halves KV HBM *and* decode bandwidth — the decode tick is
+        # KV-bandwidth-bound).  Writes round on scatter, reads upcast in
+        # the gather/kernel; attention math stays in f32 either way.
+        kv_dtype = kv_dtype or compute_dtype
         n_kv = n_kv_heads or n_heads
         self.lanes = lanes
         self.max_len = max_len
@@ -556,20 +571,27 @@ class ContinuousBatcher:
         # +1: page 0 is the reserved scratch page.  GQA pools store the
         # compact n_kv_heads form — KV HBM shrinks by n_heads/n_kv_heads.
         self._owns_pool = pool is None
+        if pool is not None and kv_dtype != compute_dtype \
+                and pool.dtype != kv_dtype:
+            raise ValueError(
+                f"kv_dtype={jnp.dtype(kv_dtype).name} conflicts with the "
+                f"provided pool's dtype {jnp.dtype(pool.dtype).name}")
         self.pool = pool or PagedKVPool(
             n_pages or self.max_pages * lanes + 1, page_size, n_layers,
-            n_kv, d_model // n_heads, compute_dtype, device)
+            n_kv, d_model // n_heads, kv_dtype, device)
         self.params = jax.device_put(params, self.pool.device)
         if use_kernel is None:
             # auto: the pallas ragged kernel on TPU (no dense gather in
             # HBM), the XLA gather fallback elsewhere.  A Mosaic compile
             # failure must degrade, not kill serving: probe-compile the
             # kernel once at the POOL's real geometry (page size / heads /
-            # head_dim set the VMEM tiles) and fall back if it rejects.
+            # head_dim / pool dtype set the VMEM tiles) and fall back if
+            # it rejects.
             from tpulab.tpu.platform import is_tpu
             use_kernel = is_tpu() and _kernel_compiles(
                 n_heads, d_model // n_heads, self.pool.page_size,
-                compute_dtype, self.pool.device, n_kv_heads=n_kv)
+                compute_dtype, self.pool.device, n_kv_heads=n_kv,
+                kv_dtype=self.pool.dtype)
         self.use_kernel = bool(use_kernel)
         self._step = jax.jit(
             partial(paged_decode_step, n_heads=n_heads, n_layers=n_layers,

@@ -23,7 +23,7 @@ from typing import Any, Optional
 __all__ = ["TrainCheckpointer", "abstract_like"]
 
 
-def abstract_like(tree: Any, mesh=None, shardings=None) -> Any:
+def abstract_like(tree: Any, shardings=None) -> Any:
     """Abstract restore target from a concrete (or abstract) pytree.
 
     With ``shardings`` (a matching pytree of NamedSharding, e.g. from

@@ -361,7 +361,7 @@ class GenerateContext(StreamingContext):
                 code=pb.UNKNOWN_MODEL,
                 message=f"no generation engine for {request.model_name!r}")))
             return
-        if request.temperature < 0.0:
+        if not (request.temperature >= 0.0):  # rejects negatives AND NaN
             # mirror SamplingParams' local contract instead of silently
             # coercing a sign bug to greedy
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
