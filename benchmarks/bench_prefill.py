@@ -56,23 +56,23 @@ def main() -> None:
 
     prefill = jax.jit(partial(paged_prefill, n_heads=n_heads,
                               n_layers=n_layers, compute_dtype=dtype),
-                      donate_argnums=(1, 2))
+                      donate_argnums=(1,))
     step = jax.jit(partial(paged_decode_step, n_heads=n_heads,
                            n_layers=n_layers, compute_dtype=dtype,
-                           use_kernel=False), donate_argnums=(1, 2))
+                           use_kernel=False), donate_argnums=(1,))
 
     # -- fused prefill -------------------------------------------------------
     pool = fresh_pool()
-    out = prefill(params, pool.k, pool.v, jnp.asarray(tables1),
+    out = prefill(params, pool.kv, jnp.asarray(tables1),
                   jnp.asarray(prompt[None, :]), jnp.int32(t))
     jax.block_until_ready(out)  # warm/compile
     fused_s = []
     for _ in range(args.iters):
         pool = fresh_pool()
         t0 = time.perf_counter()
-        logits, k, v = prefill(params, pool.k, pool.v, jnp.asarray(tables1),
-                               jnp.asarray(prompt[None, :]), jnp.int32(t))
-        jax.block_until_ready((logits, k, v))
+        logits, kv = prefill(params, pool.kv, jnp.asarray(tables1),
+                             jnp.asarray(prompt[None, :]), jnp.int32(t))
+        jax.block_until_ready((logits, kv))
         fused_s.append(time.perf_counter() - t0)
     fused = float(np.median(fused_s))
 
@@ -82,15 +82,15 @@ def main() -> None:
     tables[0] = tables1
 
     def replay(pool):
-        k, v = pool.k, pool.v
+        kv = pool.kv
         logits = None
         for i in range(t):
-            logits, k, v = step(
-                params, k, v, jnp.asarray(tables),
+            logits, kv = step(
+                params, kv, jnp.asarray(tables),
                 jnp.asarray([i], np.int32),
                 jnp.asarray([prompt[i]], np.int32),
                 jnp.asarray([True]))
-        jax.block_until_ready((logits, k, v))
+        jax.block_until_ready((logits, kv))
         return logits
 
     replay(fresh_pool())  # warm/compile

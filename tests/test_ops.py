@@ -99,7 +99,8 @@ def test_paged_attention_matches_gather_reference():
     # skip predicate must still attend the fresh page's first slot
     tables = jnp.asarray([[1, 2, 3], [4, 5, 7], [6, 0, 0]], jnp.int32)
     lengths = jnp.asarray([20, 16, 3], jnp.int32)
-    got = paged_decode_attention(q, k_pool, v_pool, tables, lengths)
+    got = paged_decode_attention(q, jnp.stack([k_pool, v_pool], axis=1),
+                                 tables, lengths)
     want = _paged_reference(q, k_pool, v_pool, tables, lengths)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
@@ -117,7 +118,8 @@ def test_paged_attention_gqa_matches_expanded_reference():
     v_pool = jax.random.normal(ks[2], (pages, ps, hkv, d), jnp.float32)
     tables = jnp.asarray([[1, 2, 3], [4, 5, 7], [6, 8, 9]], jnp.int32)
     lengths = jnp.asarray([21, 8, 2], jnp.int32)
-    got = paged_decode_attention(q, k_pool, v_pool, tables, lengths)
+    got = paged_decode_attention(q, jnp.stack([k_pool, v_pool], axis=1),
+                                 tables, lengths)
     want = _paged_reference(q, k_pool, v_pool, tables, lengths)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
@@ -139,7 +141,8 @@ def test_paged_attention_long_context_exceeds_pipeline_depth():
     v_pool = jax.random.normal(ks[2], (pages, ps, h, d), jnp.float32)
     tables = (1 + np.arange(b * mp, dtype=np.int32)).reshape(b, mp)
     lengths = jnp.asarray([mp * ps - 2, _NBUF * ps + 1], jnp.int32)
-    got = paged_decode_attention(q, k_pool, v_pool, tables, lengths)
+    got = paged_decode_attention(q, jnp.stack([k_pool, v_pool], axis=1),
+                                 tables, lengths)
     want = _paged_reference(q, k_pool, v_pool, tables, lengths)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
@@ -157,7 +160,8 @@ def test_paged_attention_skips_dead_pages():
     v_pool = v_pool.at[2].set(-1e6)       # dead page: poison V
     tables = jnp.asarray([[1, 2, 0, 0]], jnp.int32)
     lengths = jnp.asarray([2], jnp.int32)  # only first page, 3 tokens visible
-    out = paged_decode_attention(q, k_pool, v_pool, tables, lengths)
+    out = paged_decode_attention(q, jnp.stack([k_pool, v_pool], axis=1),
+                                 tables, lengths)
     np.testing.assert_allclose(np.asarray(out), 5.0, rtol=1e-6)
 
 

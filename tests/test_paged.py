@@ -231,7 +231,7 @@ def test_gqa_paged_matches_dense_generation():
                            compute_dtype=jnp.float32, n_kv_heads=2)
     try:
         # pool stores the compact KV form: heads axis == n_kv_heads
-        assert cb.pool.k.shape[3] == 2
+        assert cb.pool.kv.shape[4] == 2
         prompts = [np.random.default_rng(s).integers(0, 64, (4 + s,),
                                                      np.int32)
                    for s in range(3)]
@@ -528,18 +528,63 @@ def test_kv_cache_quantization_fp8(lm):
 
     # numerics: one decode tick over identical KV content, fp8 vs f32 pool
     rng = np.random.default_rng(0)
-    # pool shape: (n_layers, n_pages, page_size, n_heads, head_dim)
-    k32 = jnp.asarray(rng.uniform(-1, 1, (2, 4, 8, 2, 16)), jnp.float32)
-    v32 = jnp.asarray(rng.uniform(-1, 1, (2, 4, 8, 2, 16)), jnp.float32)
+    # fused pool shape: (n_layers, n_pages, 2, page_size, n_heads, head_dim)
+    kv32 = jnp.asarray(rng.uniform(-1, 1, (2, 4, 2, 8, 2, 16)), jnp.float32)
     tables = jnp.asarray([[1, 2]], jnp.int32)
     lengths = jnp.asarray([12], jnp.int32)
     tokens = jnp.asarray([3], jnp.int32)
     active = jnp.ones((1,), bool)
-    step = lambda k, v: paged_decode_step(
-        lm, k, v, tables, lengths, tokens, active, n_heads=2, n_layers=2,
+    step = lambda kv: paged_decode_step(
+        lm, kv, tables, lengths, tokens, active, n_heads=2, n_layers=2,
         compute_dtype=jnp.float32)[0]
-    l32 = np.asarray(step(k32, v32))
-    l8 = np.asarray(step(k32.astype(jnp.float8_e4m3fn),
-                         v32.astype(jnp.float8_e4m3fn)))
+    l32 = np.asarray(step(kv32))
+    l8 = np.asarray(step(kv32.astype(jnp.float8_e4m3fn)))
     corr = np.corrcoef(l32.ravel(), l8.ravel())[0, 1]
     assert corr > 0.98, corr
+
+
+def test_scheduler_churn_soak(lm):
+    """Priorities, preemption, prefix sharing, cancels, and page pressure
+    all at once: every surviving request must return EXACTLY its
+    single-request greedy reference — scheduler churn can reorder work but
+    never corrupt it — and all pages must come home."""
+    import random
+    dense = make_generate_fn(lm, n_heads=2, n_layers=2, max_len=64,
+                             compute_dtype=jnp.float32)
+    rng = np.random.default_rng(31)
+    pyrng = random.Random(31)
+    shared = rng.integers(0, 64, (16,), np.int32)       # 2 full pages
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=2, max_len=64,
+                           page_size=8, n_pages=13,     # 12 usable: tight
+                           compute_dtype=jnp.float32, prefix_cache=True,
+                           prefill_chunk=16)
+    try:
+        jobs = []
+        for i in range(14):
+            if pyrng.random() < 0.5:  # shared-prefix family
+                p = np.concatenate([shared,
+                                    rng.integers(0, 64, (pyrng.randint(1, 6),),
+                                                 np.int32)])
+            else:
+                p = rng.integers(0, 64, (pyrng.randint(3, 10),), np.int32)
+            steps = pyrng.randint(1, 6)
+            fut = cb.submit(p, steps, priority=pyrng.choice([0, 0, 1, 5]))
+            jobs.append((p, steps, fut))
+            if pyrng.random() < 0.2:
+                cb.cancel(fut)
+        import concurrent.futures as _f
+        ok = cancelled = 0
+        for p, steps, fut in jobs:
+            try:
+                got = fut.result(timeout=180)
+            except (Exception, _f.CancelledError):
+                # CancelledError is a BaseException on stock CPython >= 3.8
+                cancelled += 1
+                continue
+            want = np.asarray(dense(p[None, :], steps)[0])
+            np.testing.assert_array_equal(np.asarray(got), want)
+            ok += 1
+        assert ok >= 1
+    finally:
+        cb.shutdown()
+    assert cb.pool.free_pages == cb.pool.n_pages - 1

@@ -43,8 +43,8 @@ def test_paged_attention_kernel_matches_gather():
     tables = np.array([[1, 2], [3, 4], [5, 6], [7, 8]], np.int32)
     lengths = np.array([3, 17, 31, 8], np.int32)
 
-    out_k = np.asarray(paged_decode_attention(q, kp, vp, tables, lengths,
-                                              interpret=False))
+    out_k = np.asarray(paged_decode_attention(
+        q, jnp.stack([kp, vp], axis=1), tables, lengths, interpret=False))
     # XLA reference: dense gather + masked softmax (the fallback path)
     k_ctx = np.asarray(kp)[tables].reshape(b, mp * ps, h, d)
     v_ctx = np.asarray(vp)[tables].reshape(b, mp * ps, h, d)
@@ -123,7 +123,7 @@ def test_continuous_batcher_autoselects_kernel_on_tpu():
         # interpret-mode long-context test cannot catch an async slot-reuse
         # race — DMAs are synchronous there)
         mp = _NBUF + 4
-        pool_shape = (2, 2 * mp + 1, 16, 2, 128)   # (L, P, S, H, D)
+        pool_shape = (2, 2 * mp + 1, 2, 16, 2, 128)  # (L, P, 2, S, H, D)
         tables = np.zeros((2, mp), np.int32)
         tables[0, :2] = [1, 2]
         tables[1] = 2 + np.arange(mp)
@@ -131,16 +131,14 @@ def test_continuous_batcher_autoselects_kernel_on_tpu():
         tokens = np.asarray([5, 7], np.int32)
         active = np.ones((2,), bool)
         rng = np.random.default_rng(0)
-        k0 = rng.standard_normal(pool_shape).astype(np.float32)
-        v0 = rng.standard_normal(pool_shape).astype(np.float32)
+        kv0 = rng.standard_normal(pool_shape).astype(np.float32)
         logits = {}
         for uk in (True, False):
             step = jax.jit(partial(
                 paged_decode_step, n_heads=2, n_layers=2,
                 compute_dtype=jnp.float32, use_kernel=uk))
-            out, _, _ = step(params, jax.device_put(k0),
-                             jax.device_put(v0), tables, lengths,
-                             tokens, active)
+            out, _ = step(params, jax.device_put(kv0), tables, lengths,
+                          tokens, active)
             logits[uk] = np.asarray(out)
         # the gather path's einsums run at default MXU precision (f32
         # operands rounded to bf16) while the kernel pins HIGHEST, so the
@@ -210,8 +208,8 @@ def test_gqa_kernel_on_tpu():
     vp = jnp.asarray(rng.standard_normal((pages, ps, hkv, d)), jnp.float32)
     tables = np.array([[1, 2], [3, 4], [5, 6], [7, 8]], np.int32)
     lengths = np.array([3, 17, 31, 8], np.int32)
-    out = np.asarray(paged_decode_attention(q, kp, vp, tables, lengths,
-                                            interpret=False))
+    out = np.asarray(paged_decode_attention(
+        q, jnp.stack([kp, vp], axis=1), tables, lengths, interpret=False))
     k_ctx = np.repeat(np.asarray(kp)[tables].reshape(b, mp * ps, hkv, d),
                       hq // hkv, axis=2)
     v_ctx = np.repeat(np.asarray(vp)[tables].reshape(b, mp * ps, hkv, d),

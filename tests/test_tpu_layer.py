@@ -257,10 +257,10 @@ def test_paged_pool_hbm_tracked_and_closed():
 
     pool = PagedKVPool(n_pages=4, page_size=8, n_layers=2, n_heads=2,
                        head_dim=4, dtype=jnp.float32)
-    expect = 2 * (2 * 4 * 8 * 2 * 4) * 4  # k+v * shape * itemsize
+    expect = (2 * 4 * 2 * 8 * 2 * 4) * 4  # fused (L,P,2,S,H,D) * itemsize
     assert pool.hbm_bytes == expect
     # setter keeps accounting through a rotation
-    pool.k = jnp.ones_like(pool.k)
+    pool.kv = jnp.ones_like(pool.kv)
     assert pool.hbm_bytes == expect
     pool.close()
     assert pool.hbm_bytes == 0

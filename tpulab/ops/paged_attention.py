@@ -5,13 +5,17 @@ attention): each lane attends one query token against its own block table of
 KV pages.  The XLA fallback in :func:`tpulab.engine.paged.paged_decode_step`
 *gathers* every lane's pages into a dense (B, MP*S, H, D) tensor — correct
 but materializes the gather in HBM; this kernel instead walks the block
-table per lane, DMA-ing one K/V page at a time from the pool (HBM) into
+table per lane, DMA-ing one page at a time from the pool (HBM) into
 VMEM scratch and accumulating softmax online — O(page) VMEM, no gather
 materialization, and dead pages (beyond the lane's length) are skipped by
-predication.  Page DMAs ride an ``_NBUF``-deep prefetch pipeline (slot
-rotation: iteration j waits slot ``j % _NBUF``, computes, then refills the
-previous iteration's slot with page ``j + _NBUF - 1``), amortizing the
-per-DMA issue latency across ``_NBUF - 1`` in-flight copies.
+predication.  Pages use the FUSED layout (P, 2, S, Hkv*D): a page's K and
+V rows are adjacent in HBM and arrive in ONE DMA — the walk is
+DMA-issue-latency-bound, so fusing halves the issue count vs separate
+K/V pools.  Page DMAs additionally ride an ``_NBUF``-deep prefetch
+pipeline (slot rotation: iteration j waits slot ``j % _NBUF``, computes,
+then refills the previous iteration's slot with page ``j + _NBUF - 1``),
+amortizing the per-DMA issue latency across ``_NBUF - 1`` in-flight
+copies.
 
 Scalar-prefetched block tables/lengths drive the page DMAs (the
 PrefetchScalarGridSpec pattern).  ``interpret=True`` (automatic off TPU)
@@ -23,8 +27,9 @@ head-selector matrix ((H*D, H)) instead of batched ``dot_general``
 dimension numbers — batched dots fail to round-trip through the TPU
 compile service's MLIR text serialization, and middle-dimension DMA
 slices (the per-head-DMA alternative) require 128-lane alignment that
-head_dim=64 models don't satisfy.  Pages are therefore staged as
-(page_size, H*D) rows (a free, contiguous reshape at the caller).
+head_dim=64 models don't satisfy.  Pages are therefore staged as fused
+(2, page_size, Hkv*D) K/V blocks (a free, contiguous reshape at the
+caller).
 """
 
 from __future__ import annotations
@@ -52,8 +57,8 @@ def _slot_count(page_size: int, hd: int, itemsize: int) -> int:
     return max(2, min(_NBUF, _VMEM_BUDGET_BYTES // (2 * page_bytes)))
 
 
-def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
-                       o_ref, k_buf, v_buf, sem, *, page_size: int,
+def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kvpool_ref,
+                       o_ref, kv_buf, sem, *, page_size: int,
                        max_pages: int, n_heads: int, head_dim: int,
                        n_kv_heads: int, sm_scale: float, precision,
                        nbuf: int):
@@ -95,19 +100,18 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
         preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST)
 
+    # fused page layout (2, S, Hkv*D): K and V of a page are adjacent in
+    # HBM, so ONE DMA per page fetches both — the loop is DMA-issue-bound
+    # and this halves the issue count vs separate K/V pools
     def start_dma(j, slot):
         page = tables_ref[lane * max_pages + j]
-        pltpu.make_async_copy(kpool_ref.at[page], k_buf.at[slot],
-                              sem.at[slot, 0]).start()
-        pltpu.make_async_copy(vpool_ref.at[page], v_buf.at[slot],
-                              sem.at[slot, 1]).start()
+        pltpu.make_async_copy(kvpool_ref.at[page], kv_buf.at[slot],
+                              sem.at[slot]).start()
 
     def wait_dma(j, slot):
         page = tables_ref[lane * max_pages + j]
-        pltpu.make_async_copy(kpool_ref.at[page], k_buf.at[slot],
-                              sem.at[slot, 0]).wait()
-        pltpu.make_async_copy(vpool_ref.at[page], v_buf.at[slot],
-                              sem.at[slot, 1]).wait()
+        pltpu.make_async_copy(kvpool_ref.at[page], kv_buf.at[slot],
+                              sem.at[slot]).wait()
 
     def live(j):
         return j * page_size <= length
@@ -141,8 +145,8 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
                 start_dma(j + nbuf - 1,
                           jax.lax.rem(j + nbuf - 1, nbuf))
 
-            k = k_buf[slot].astype(jnp.float32)   # (S, Hkv*D)
-            v = v_buf[slot].astype(jnp.float32)
+            k = kv_buf[slot, 0].astype(jnp.float32)   # (S, Hkv*D)
+            v = kv_buf[slot, 1].astype(jnp.float32)
             if g > 1:
                 k = dot2(k, expand)               # (S, H*D) GQA broadcast
                 v = dot2(v, expand)
@@ -172,41 +176,38 @@ def _paged_attn_kernel(tables_ref, lengths_ref, q_ref, kpool_ref, vpool_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
+def _paged_attn(q, kv_pool, tables, lengths, interpret: bool):
     b, h, d = q.shape
-    n_pages, page_size, hkv = (k_pool.shape[0], k_pool.shape[1],
-                               k_pool.shape[2])
+    n_pages, page_size, hkv = (kv_pool.shape[0], kv_pool.shape[2],
+                               kv_pool.shape[3])
     if h % hkv:
         raise ValueError(f"q heads {h} not divisible by kv heads {hkv}")
     max_pages = tables.shape[1]
-    # stage pages as (S, Hkv*D) rows: contiguous (free) reshape, keeps
-    # every in-kernel dot 2D (see module docstring)
+    # stage pages as (2, S, Hkv*D) fused K/V blocks: contiguous (free)
+    # reshape, keeps every in-kernel dot 2D (see module docstring)
     # rank-3 (B, 1, H*D) so the (1, 1, H*D) block's last two dims equal the
     # array dims exactly (the Pallas TPU block tiling rule)
     q2 = q.reshape(b, 1, h * d)
-    kp2 = k_pool.reshape(n_pages, page_size, hkv * d)
-    vp2 = v_pool.reshape(n_pages, page_size, hkv * d)
-    nbuf = _slot_count(page_size, hkv * d, jnp.dtype(k_pool.dtype).itemsize)
+    kvp = kv_pool.reshape(n_pages, 2, page_size, hkv * d)
+    nbuf = _slot_count(page_size, hkv * d, jnp.dtype(kv_pool.dtype).itemsize)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                 # tables (flat), lengths
         grid=(b,),
         in_specs=[
             pl.BlockSpec((1, 1, h * d), lambda lane, *_: (lane, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),      # K pool stays in HBM
-            pl.BlockSpec(memory_space=pl.ANY),      # V pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # KV pool stays in HBM
         ],
         out_specs=pl.BlockSpec((1, 1, h * d), lambda lane, *_: (lane, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((nbuf, page_size, hkv * d), k_pool.dtype),
-            pltpu.VMEM((nbuf, page_size, hkv * d), v_pool.dtype),
-            pltpu.SemaphoreType.DMA((nbuf, 2)),               # [slot][k/v]
+            pltpu.VMEM((nbuf, 2, page_size, hkv * d), kv_pool.dtype),
+            pltpu.SemaphoreType.DMA((nbuf,)),        # one DMA per page
         ],
     )
     # f32 pools pin HIGHEST on the score dot (the default rounds f32 MXU
     # operands to bf16, costing ~3 decimal digits); bf16 pools keep the
     # fast default — the score operands carry no extra bits to preserve
     precision = (jax.lax.Precision.HIGHEST
-                 if jnp.dtype(k_pool.dtype).itemsize >= 4
+                 if jnp.dtype(kv_pool.dtype).itemsize >= 4
                  else jax.lax.Precision.DEFAULT)
     kernel = functools.partial(
         _paged_attn_kernel, page_size=page_size, max_pages=max_pages,
@@ -217,18 +218,20 @@ def _paged_attn(q, k_pool, v_pool, tables, lengths, interpret: bool):
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, 1, h * d), q.dtype),
         interpret=interpret,
-    )(tables.reshape(-1), lengths, q2, kp2, vp2)
+    )(tables.reshape(-1), lengths, q2, kvp)
     return out.reshape(b, h, d)
 
 
-def paged_decode_attention(q, k_pool, v_pool, tables, lengths,
+def paged_decode_attention(q, kv_pool, tables, lengths,
                            interpret: bool | None = None):
     """Ragged paged decode attention (MHA or grouped-query).
 
     q (B, Hq, D) — one query token per lane;
-    k_pool/v_pool (P, S, Hkv, D) — one layer's page pool (``Hkv < Hq``
-    selects GQA: pages DMA in the compact Hkv form and broadcast to the
-    query heads inside the kernel, so KV bandwidth shrinks by Hq/Hkv);
+    kv_pool (P, 2, S, Hkv, D) — one layer's page pool in the FUSED layout:
+    index 0/1 of axis 1 holds the page's K/V rows adjacently in HBM, so
+    the kernel fetches both with one DMA per page (``Hkv < Hq`` selects
+    GQA: pages DMA in the compact Hkv form and broadcast to the query
+    heads inside the kernel, so KV bandwidth shrinks by Hq/Hkv);
     tables (B, MP) int32 page ids (padded rows point at the scratch page 0);
     lengths (B,) int32 — the current position per lane (inclusive visibility).
     Returns (B, Hq, D).
@@ -236,5 +239,5 @@ def paged_decode_attention(q, k_pool, v_pool, tables, lengths,
     if interpret is None:
         from tpulab.tpu.platform import is_tpu
         interpret = not is_tpu()
-    return _paged_attn(q, k_pool, v_pool, tables.astype(jnp.int32),
+    return _paged_attn(q, kv_pool, tables.astype(jnp.int32),
                        lengths.astype(jnp.int32), interpret)
