@@ -92,3 +92,63 @@ def test_w8a8_out_of_range_input_clips_not_explodes(rn_params):
     wild = {"input": np.full((1, 32, 32, 3), 50.0, np.float32)}
     out = np.asarray(resnet_apply(q, wild, compute_dtype=jnp.float32)["logits"])
     assert np.isfinite(out).all()
+
+
+# ------------------------------------------------- transformer weight-only --
+def test_quantize_transformer_params_w8a16():
+    """Weight-only int8 transformer: 4x smaller projections, logits
+    tracking f32 closely, and the serving stack (dense generation, paged
+    batcher with prefill+extend) runs unchanged on quantized params."""
+    import jax.numpy as jnp
+    from tpulab.engine.paged import ContinuousBatcher
+    from tpulab.models.quantization import (quantize_transformer_params,
+                                            transformer_param_bytes)
+    from tpulab.models.transformer import (init_transformer_params,
+                                           make_generate_fn,
+                                           transformer_apply)
+
+    params = init_transformer_params(vocab=64, d_model=64, n_heads=4,
+                                     n_layers=2, d_ff=128)
+    qparams = quantize_transformer_params(params)
+    # size: projections shrink 4x (f32 -> int8); embeds/norms keep float
+    assert transformer_param_bytes(qparams) < \
+        0.45 * transformer_param_bytes(params)
+
+    tokens = np.random.default_rng(0).integers(0, 64, (2, 16), np.int32)
+    kw = dict(n_heads=4, n_layers=2, compute_dtype=jnp.float32)
+    lf = transformer_apply(params, {"tokens": tokens}, **kw)["logits"]
+    lq = transformer_apply(qparams, {"tokens": tokens}, **kw)["logits"]
+    corr = np.corrcoef(np.asarray(lf).ravel(), np.asarray(lq).ravel())[0, 1]
+    assert corr > 0.995, corr
+
+    # serving stack: dense generate + paged batcher over quantized params
+    dense_q = make_generate_fn(qparams, n_heads=4, n_layers=2, max_len=48,
+                               compute_dtype=jnp.float32)
+    cb = ContinuousBatcher(qparams, n_heads=4, n_layers=2, lanes=2,
+                           max_len=48, page_size=8,
+                           compute_dtype=jnp.float32, prefix_cache=True)
+    try:
+        p = np.random.default_rng(1).integers(0, 64, (12,), np.int32)
+        got = cb.submit(p, 6).result(timeout=120)
+        want = np.asarray(dense_q(p[None, :], 6)[0])
+        # paged-vs-dense must agree exactly on the SAME quantized params
+        np.testing.assert_array_equal(np.asarray(got), want)
+    finally:
+        cb.shutdown()
+
+
+def test_quantized_untied_lm_head():
+    import jax.numpy as jnp
+    from tpulab.models.quantization import quantize_transformer_params
+    from tpulab.models.transformer import (init_transformer_params,
+                                           transformer_apply)
+    params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                     n_layers=1, d_ff=64,
+                                     tie_embeddings=False)
+    assert "lm_head" in params
+    qparams = quantize_transformer_params(params)
+    assert "w_int8" in qparams["lm_head"]
+    tokens = np.zeros((1, 4), np.int32)
+    out = transformer_apply(qparams, {"tokens": tokens}, n_heads=2,
+                            n_layers=1, compute_dtype=jnp.float32)
+    assert out["logits"].shape == (1, 4, 64)

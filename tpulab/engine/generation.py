@@ -46,7 +46,8 @@ class GenerationEngine:
         self.n_kv_heads = n_kv_heads or n_heads
         self.max_len = max_len
         self.params = jax.device_put(params, self.device)
-        d_model = params["layer0"]["wqkv"].shape[0]
+        from tpulab.models.transformer import weight_shape
+        d_model = weight_shape(params["layer0"]["wqkv"])[0]
         self.head_dim = d_model // n_heads
 
         self._decode = jax.jit(partial(

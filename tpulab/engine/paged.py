@@ -74,16 +74,6 @@ class PagedKVPool:
         self._kv = self._alloc.replace(self._kv_addr, value)
 
     @property
-    def k(self):
-        """Read-only K view in the classic (L, P, S, H, D) layout."""
-        return self._kv[:, :, 0]
-
-    @property
-    def v(self):
-        """Read-only V view in the classic (L, P, S, H, D) layout."""
-        return self._kv[:, :, 1]
-
-    @property
     def dtype(self):
         """Page storage dtype (may be narrower than the compute dtype —
         KV-cache quantization)."""
@@ -228,7 +218,7 @@ def paged_decode_step(params, kv_pool, tables, lengths, tokens,
     """
     import jax.numpy as jnp
     from tpulab.models.transformer import (_dense_ffn, _lm_head, _rmsnorm,
-                                           apply_rope, split_qkv)
+                                           apply_rope, qmat, split_qkv)
 
     n_kv = n_kv_heads or n_heads
     b = tokens.shape[0]
@@ -244,7 +234,7 @@ def paged_decode_step(params, kv_pool, tables, lengths, tokens,
     for layer in range(n_layers):
         p = params[f"layer{layer}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
-        qkv = h @ p["wqkv"].astype(compute_dtype)
+        qkv = h @ qmat(p["wqkv"], compute_dtype)
         q, knew, vnew = split_qkv(qkv, b, 1, n_heads, n_kv, head_dim)
         if rope_theta:
             # per-lane positions: each lane decodes at its own length
@@ -272,7 +262,7 @@ def paged_decode_step(params, kv_pool, tables, lengths, tokens,
             attn = _gather_attend(q, kv_pool[layer, :, 0],
                                   kv_pool[layer, :, 1], tables,
                                   lengths[:, None], compute_dtype)
-        x = x + attn @ p["wo"].astype(compute_dtype)
+        x = x + attn @ qmat(p["wo"], compute_dtype)
         h2 = _rmsnorm(x, p["ln2"]["scale"])
         x = x + _dense_ffn(p, h2, compute_dtype).astype(x.dtype)
 
@@ -341,7 +331,7 @@ def paged_extend(params, kv_pool, tables, tokens, start, valid_total,
     """
     import jax.numpy as jnp
     from tpulab.models.transformer import (_dense_ffn, _lm_head, _rmsnorm,
-                                           apply_rope, split_qkv)
+                                           apply_rope, qmat, split_qkv)
 
     n_kv = n_kv_heads or n_heads
     page_size = kv_pool.shape[3]
@@ -358,7 +348,7 @@ def paged_extend(params, kv_pool, tables, tokens, start, valid_total,
     for layer in range(n_layers):
         p = params[f"layer{layer}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
-        qkv = h @ p["wqkv"].astype(compute_dtype)
+        qkv = h @ qmat(p["wqkv"], compute_dtype)
         q, knew, vnew = split_qkv(qkv, 1, m_pad, n_heads, n_kv, head_dim)
         if rope_theta:
             q = apply_rope(q, pos, rope_theta)
@@ -370,7 +360,7 @@ def paged_extend(params, kv_pool, tables, tokens, start, valid_total,
         # gather-after-scatter: context = cached prefix + this tail
         attn = _gather_attend(q, kv_pool[layer, :, 0], kv_pool[layer, :, 1],
                               tables[None], pos[None], compute_dtype)
-        x = x + attn @ p["wo"].astype(compute_dtype)
+        x = x + attn @ qmat(p["wo"], compute_dtype)
         h2 = _rmsnorm(x, p["ln2"]["scale"])
         x = x + _dense_ffn(p, h2, compute_dtype).astype(x.dtype)
 
@@ -575,7 +565,8 @@ class ContinuousBatcher:
         self.max_len = max_len
         self.page_size = page_size
         self.max_pages = (max_len + page_size - 1) // page_size
-        d_model = params["layer0"]["wqkv"].shape[0]
+        from tpulab.models.transformer import weight_shape
+        d_model = weight_shape(params["layer0"]["wqkv"])[0]
         # +1: page 0 is the reserved scratch page.  GQA pools store the
         # compact n_kv_heads form — KV HBM shrinks by n_heads/n_kv_heads.
         self._owns_pool = pool is None

@@ -100,6 +100,28 @@ def apply_rope(x, positions, theta: float = 10000.0):
     return (x.astype(jnp.float32) * cos + rot * sin).astype(x.dtype)
 
 
+def qmat(w, compute_dtype):
+    """Weight matrix ready for matmul, transparently dequantizing
+    weight-only INT8 entries ({"w_int8", "scale"} from
+    :func:`tpulab.models.quantization.quantize_transformer_params`).
+
+    TPU-first W8A16: the int8 matrix is what lives in (and streams from)
+    HBM — the 2-4x smaller read is the win, since small-batch decode is
+    weight-bandwidth-bound; the cast and per-output-channel scale are
+    cheap VPU work XLA fuses into the consuming matmul's operand read.
+    """
+    if isinstance(w, dict) and "w_int8" in w:
+        return (w["w_int8"].astype(compute_dtype)
+                * w["scale"].astype(compute_dtype))
+    return w.astype(compute_dtype)
+
+
+def weight_shape(w):
+    """Shape of a (possibly weight-only-quantized) weight matrix."""
+    return (w["w_int8"] if isinstance(w, dict) and "w_int8" in w
+            else w).shape
+
+
 def _rmsnorm(x, scale):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
@@ -125,18 +147,18 @@ def _dense_ffn(p, h, compute_dtype):
     """Default FFN block: SwiGLU when the layer has a ``w3`` gate
     projection (the Llama family), else w1/gelu/w2."""
     if "w3" in p:
-        return (jax.nn.silu(h @ p["w1"].astype(compute_dtype))
-                * (h @ p["w3"].astype(compute_dtype))) \
-            @ p["w2"].astype(compute_dtype)
-    return jax.nn.gelu(h @ p["w1"].astype(compute_dtype)) \
-        @ p["w2"].astype(compute_dtype)
+        return (jax.nn.silu(h @ qmat(p["w1"], compute_dtype))
+                * (h @ qmat(p["w3"], compute_dtype))) \
+            @ qmat(p["w2"], compute_dtype)
+    return jax.nn.gelu(h @ qmat(p["w1"], compute_dtype)) \
+        @ qmat(p["w2"], compute_dtype)
 
 
 def _lm_head(params, x):
     """Final projection: untied ``lm_head`` when present, else tied to the
     embedding matrix."""
     if "lm_head" in params:
-        return x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
+        return x.astype(jnp.float32) @ qmat(params["lm_head"], jnp.float32)
     return x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
 
 
@@ -161,7 +183,7 @@ def _forward(params, tokens, n_heads, n_layers, compute_dtype, attention_fn,
     for i in range(n_layers):
         p = params[f"layer{i}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
-        qkv = h @ p["wqkv"].astype(compute_dtype)
+        qkv = h @ qmat(p["wqkv"], compute_dtype)
         q, k, v = split_qkv(qkv, b, t, n_heads, n_kv, head_dim)
         if rope_theta:
             q = apply_rope(q, positions, rope_theta)
@@ -170,7 +192,7 @@ def _forward(params, tokens, n_heads, n_layers, compute_dtype, attention_fn,
             kvs.append((k, v))
         attn = attention_fn(q, repeat_kv(k, n_heads),
                             repeat_kv(v, n_heads)).reshape(b, t, d_model)
-        x = x + attn @ p["wo"].astype(compute_dtype)
+        x = x + attn @ qmat(p["wo"], compute_dtype)
         h = _rmsnorm(x, p["ln2"]["scale"])
         x = x + ffn_fn(p, h, compute_dtype).astype(x.dtype)
     x = _rmsnorm(x, params["final_norm"]["scale"])
@@ -275,7 +297,7 @@ def transformer_chunk_step(params: Dict[str, Any], cache: Dict[str, Any],
     for i in range(n_layers):
         p = params[f"layer{i}"]
         h = _rmsnorm(x, p["ln1"]["scale"])
-        qkv = h @ p["wqkv"].astype(compute_dtype)
+        qkv = h @ qmat(p["wqkv"], compute_dtype)
         q, k, v = split_qkv(qkv, b, m, n_heads, n_kv, head_dim)
         if rope_theta:
             q = apply_rope(q, positions, rope_theta)
@@ -298,7 +320,7 @@ def transformer_chunk_step(params: Dict[str, Any], cache: Dict[str, Any],
         probs = jax.nn.softmax(scores, axis=-1).astype(compute_dtype)
         attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs,
                           cv.astype(compute_dtype)).reshape(b, m, d_model)
-        x = x + attn @ p["wo"].astype(compute_dtype)
+        x = x + attn @ qmat(p["wo"], compute_dtype)
         h2 = _rmsnorm(x, p["ln2"]["scale"])
         x = x + _dense_ffn(p, h2, compute_dtype).astype(x.dtype)
     x = _rmsnorm(x, params["final_norm"]["scale"])
