@@ -350,15 +350,21 @@ def main() -> None:
     # paged-decode kernel row (chip only): pallas ragged kernel vs XLA
     # gather at B=8, 2k context — the beyond-reference serving differentiator
     if not degraded and not cpu_full:
-        try:
-            from tpulab.tpu.platform import is_tpu
-            if is_tpu():
+        from tpulab.tpu.platform import is_tpu
+        if is_tpu():
+            try:
                 _phase("paged_decode_kernel")
                 from tpulab.engine.paged import (
                     benchmark_decode_kernel_vs_gather)
                 _record(paged_decode=benchmark_decode_kernel_vs_gather())
-        except Exception as e:
-            print(f"# paged decode row skipped: {e!r}", file=sys.stderr)
+            except Exception as e:
+                print(f"# paged decode row skipped: {e!r}", file=sys.stderr)
+            try:
+                _phase("llm_decode_w8a16")
+                from tpulab.engine.paged import benchmark_llm_decode
+                _record(llm_decode=benchmark_llm_decode())
+            except Exception as e:
+                print(f"# llm decode row skipped: {e!r}", file=sys.stderr)
 
     # flagship serving config (examples/02 analog): gRPC + dynamic batching
     # over localhost, siege at depth 32 (reference 98-series measurement)

@@ -223,3 +223,18 @@ def test_gqa_kernel_on_tpu():
     p /= p.sum(-1, keepdims=True)
     want = np.einsum("bhs,bshd->bhd", p, v_ctx)
     np.testing.assert_allclose(out, want, atol=2e-3, rtol=2e-3)
+
+
+def test_llm_decode_int8_weights_on_tpu():
+    """W8A16 LLM decode on hardware: int8 weights stream from HBM and
+    dequantize into the matmuls; tokens/s for bf16 vs int8 weights at a
+    GQA geometry (same helper the bench's llm_decode row uses)."""
+    _require_tpu()
+    from tpulab.engine.paged import benchmark_llm_decode
+
+    row = benchmark_llm_decode(n_layers=4, iters=32)
+    print(f"[hw perf] llm decode tokens/s at B={row['b']} ctx={row['ctx']}: "
+          f"bf16={row['bf16_tok_s']:.0f} ({row.get('bf16_param_mb')}MB) "
+          f"int8={row['int8_tok_s']:.0f} ({row.get('int8_param_mb')}MB)")
+    assert row["bf16_tok_s"] > 0, row.get("bf16_error")
+    assert row["int8_tok_s"] > 0, row.get("int8_error")

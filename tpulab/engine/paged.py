@@ -991,6 +991,37 @@ class ContinuousBatcher:
         self._requests.pop(req.future, None)
 
 
+def _timed_decode_tok_s(step, params_dev, kv0, tables, lengths, tokens,
+                        active, lanes: int, iters: int) -> float:
+    """Scan-chained, fetch-fenced decode timing (the load-bearing bench
+    discipline: all iters ride ONE dispatch via lax.scan — through a relay
+    tunnel per-dispatch RTT is tens of ms and would measure the link — and
+    the fence is a host fetch of the tiny logits trace, because
+    block_until_ready does NOT guarantee execution completed on
+    remote-relay backends).  Returns best-of-2 tokens/s."""
+    import time
+
+    import jax
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def run_n(p, kv, tables, lengths, tokens, active):
+        def body(kv, _):
+            logits, kv = step(p, kv, tables, lengths, tokens, active)
+            return kv, logits[0, 0]
+        kv, ls = jax.lax.scan(body, kv, None, length=iters)
+        return ls, kv
+
+    ls, kv = run_n(params_dev, kv0, tables, lengths, tokens, active)
+    np.asarray(ls)  # compile + warm (fetch = execution fence)
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        ls, kv = run_n(params_dev, kv, tables, lengths, tokens, active)
+        np.asarray(ls)
+        best = min(best, time.perf_counter() - t0)
+    return lanes * iters / best
+
+
 def benchmark_decode_kernel_vs_gather(n_heads: int = 8, n_layers: int = 4,
                                       d_model: int = 1024,
                                       page_size: int = 32, lanes: int = 8,
@@ -999,9 +1030,6 @@ def benchmark_decode_kernel_vs_gather(n_heads: int = 8, n_layers: int = 4,
     """tokens/s of the pallas ragged-paged-attention decode vs the XLA
     gather fallback at a long-context geometry (the bench perf row and
     the hardware test share this; VERDICT round-1 #3)."""
-    import time
-
-    import jax
     import jax.numpy as jnp
 
     from tpulab.models.transformer import init_transformer_params
@@ -1023,34 +1051,76 @@ def benchmark_decode_kernel_vs_gather(n_heads: int = 8, n_layers: int = 4,
             step = partial(
                 paged_decode_step, n_heads=n_heads, n_layers=n_layers,
                 compute_dtype=dtype, use_kernel=uk)
+            row[f"{label}_tok_s"] = round(_timed_decode_tok_s(
+                step, params, pool.kv, tables, lengths, tokens, active,
+                lanes, iters), 1)
+        except Exception as e:
+            row[f"{label}_tok_s"] = 0.0
+            row[f"{label}_error"] = f"{type(e).__name__}: {str(e)[:160]}"
+        finally:
+            pool.close()
+    return row
 
-            # all iters ride ONE dispatch (lax.scan on device): through a
-            # relay tunnel the per-dispatch RTT is tens of ms, which would
-            # otherwise dominate and measure the link, not the kernel.
-            # The timing fence is a host fetch of the tiny logits trace —
-            # block_until_ready does NOT guarantee execution completed on
-            # remote-relay backends (execution can be demand-driven), so
-            # fetching a result is the only sound fence.
-            @partial(jax.jit, donate_argnums=(1,))
-            def run_n(params, kv, tables, lengths, tokens, active):
-                def body(kv, _):
-                    logits, kv = step(params, kv, tables, lengths,
-                                      tokens, active)
-                    return kv, logits[0, 0]
-                kv, ls = jax.lax.scan(body, kv, None, length=iters)
-                return ls, kv
 
-            kv = pool.kv
-            ls, kv = run_n(params, kv, tables, lengths, tokens, active)
-            np.asarray(ls)  # compile + warm (fetch = execution fence)
-            best = float("inf")
-            for _ in range(2):
-                t0 = time.perf_counter()
-                ls, kv = run_n(params, kv, tables, lengths, tokens,
-                               active)
-                np.asarray(ls)
-                best = min(best, time.perf_counter() - t0)
-            row[f"{label}_tok_s"] = round(lanes * iters / best, 1)
+def benchmark_llm_decode(n_heads: int = 16, n_kv_heads: int = 4,
+                         n_layers: int = 8, d_model: int = 1024,
+                         d_ff: int = 4096, vocab: int = 8192,
+                         page_size: int = 16, lanes: int = 8,
+                         ctx: int = 1024, iters: int = 64,
+                         dtype=None) -> Dict[str, Any]:
+    """Paged decode tokens/s with bf16 vs weight-only-int8 params (W8A16)
+    at a Llama-ish GQA geometry — small-batch decode is weight-bandwidth
+    bound, so int8 weights are the serving-latency lever this row
+    measures.  Same scan-chained, fetch-fenced discipline as
+    :func:`benchmark_decode_kernel_vs_gather`."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.quantization import (quantize_transformer_params,
+                                            transformer_param_bytes)
+    from tpulab.models.transformer import init_transformer_params
+
+    dtype = dtype or jnp.bfloat16
+
+    def to_bf16(tree):
+        # cast every float leaf; int8 payloads pass through untouched
+        return jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, jnp.bfloat16)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+            tree)
+
+    mp = ctx // page_size
+    # untied head so the LARGEST per-step weight read (lm_head) is part of
+    # what quantization shrinks; the int8 variant's remaining float leaves
+    # (embed, norms, scales) are bf16 like the baseline — the comparison
+    # isolates exactly the weight-width axis
+    params = init_transformer_params(vocab=vocab, d_model=d_model,
+                                     n_heads=n_heads, n_layers=n_layers,
+                                     d_ff=d_ff, n_kv_heads=n_kv_heads,
+                                     tie_embeddings=False)
+    variants = {
+        "bf16": to_bf16(params),
+        "int8": to_bf16(quantize_transformer_params(params)),
+    }
+    tables = np.arange(1, lanes * mp + 1, dtype=np.int32).reshape(lanes, mp)
+    lengths = np.full((lanes,), ctx - 2, np.int32)
+    tokens = np.zeros((lanes,), np.int32)
+    active = np.ones((lanes,), bool)
+    row: Dict[str, Any] = {"b": lanes, "ctx": ctx,
+                           "layers": n_layers, "d_model": d_model}
+    for label, p in variants.items():
+        pool = PagedKVPool(lanes * mp + 1, page_size, n_layers, n_kv_heads,
+                           d_model // n_heads, dtype)
+        try:
+            step = partial(paged_decode_step, n_heads=n_heads,
+                           n_layers=n_layers, compute_dtype=dtype,
+                           use_kernel=False, n_kv_heads=n_kv_heads)
+            pdev = jax.device_put(p, pool.device)
+            row[f"{label}_tok_s"] = round(_timed_decode_tok_s(
+                step, pdev, pool.kv, tables, lengths, tokens, active,
+                lanes, iters), 1)
+            row[f"{label}_param_mb"] = round(
+                transformer_param_bytes(p) / 2**20, 1)
         except Exception as e:
             row[f"{label}_tok_s"] = 0.0
             row[f"{label}_error"] = f"{type(e).__name__}: {str(e)[:160]}"
