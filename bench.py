@@ -350,8 +350,13 @@ def main() -> None:
     # paged-decode kernel row (chip only): pallas ragged kernel vs XLA
     # gather at B=8, 2k context — the beyond-reference serving differentiator
     if not degraded and not cpu_full:
-        from tpulab.tpu.platform import is_tpu
-        if is_tpu():
+        try:
+            from tpulab.tpu.platform import is_tpu
+            on_tpu = is_tpu()
+        except Exception as e:
+            on_tpu = False
+            print(f"# platform probe failed: {e!r}", file=sys.stderr)
+        if on_tpu:
             try:
                 _phase("paged_decode_kernel")
                 from tpulab.engine.paged import (

@@ -174,6 +174,29 @@ def _kernel_compiles(n_heads: int, head_dim: int, page_size: int,
         return False
 
 
+@functools.lru_cache(maxsize=None)
+def _flash_compiles(head_dim: int, compute_dtype, device) -> bool:
+    """One-shot probe: does the pallas flash-attention kernel compile+run
+    on this device at this head_dim?  Mosaic rejection selects the dense
+    causal fallback for prefill."""
+    import jax
+    import jax.numpy as jnp
+    from tpulab.ops.flash_attention import flash_attention
+    try:
+        q = jax.device_put(jnp.zeros((1, 128, 1, head_dim), compute_dtype),
+                           device)
+        out = flash_attention(q, q, q, causal=True, interpret=False)
+        jax.block_until_ready(out)
+        return True
+    except Exception as e:
+        import logging
+        logging.getLogger("tpulab.engine").warning(
+            "pallas flash-attention prefill unavailable on this device "
+            "(%s: %s); using dense causal attention",
+            type(e).__name__, str(e)[:200])
+        return False
+
+
 def _gather_attend(q, k_layer, v_layer, tables, qpos, compute_dtype):
     """Dense-gather paged attention (the XLA fallback math, single source
     of truth for decode ticks and extend/chunked prefill).
@@ -276,7 +299,8 @@ def paged_decode_step(params, kv_pool, tables, lengths, tokens,
 def paged_prefill(params, kv_pool, tables, tokens, valid_len,
                   n_heads: int, n_layers: int, compute_dtype,
                   n_kv_heads: Optional[int] = None,
-                  rope_theta: Optional[float] = None):
+                  rope_theta: Optional[float] = None,
+                  attention_fn=None):
     """Fused prefill: ONE causal forward over the (padded) prompt, with each
     layer's K/V scattered straight into the lane's pages.
 
@@ -287,14 +311,16 @@ def paged_prefill(params, kv_pool, tables, tokens, valid_len,
     """
     import jax
     import jax.numpy as jnp
-    from tpulab.models.transformer import transformer_forward_collect_kv
+    from tpulab.models.transformer import (causal_attention,
+                                           transformer_forward_collect_kv)
 
     page_size = kv_pool.shape[3]
     t_pad = tokens.shape[1]
     logits, kvs = transformer_forward_collect_kv(
         params, tokens, n_heads=n_heads, n_layers=n_layers,
         compute_dtype=compute_dtype, n_kv_heads=n_kv_heads,
-        rope_theta=rope_theta)
+        rope_theta=rope_theta,
+        attention_fn=attention_fn or causal_attention)
     pos = jnp.arange(t_pad)
     valid = pos < valid_len
     page_idx = jnp.where(valid, tables[pos // page_size], 0)  # scratch if pad
@@ -549,7 +575,8 @@ class ContinuousBatcher:
                  rope_theta: Optional[float] = None,
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
-                 kv_dtype=None):
+                 kv_dtype=None,
+                 prefill_flash: Optional[bool] = None):
         import jax
         import jax.numpy as jnp
 
@@ -597,12 +624,23 @@ class ContinuousBatcher:
                     compute_dtype=compute_dtype, use_kernel=self.use_kernel,
                     n_kv_heads=n_kv, rope_theta=rope_theta),
             donate_argnums=(1,))
-        # fused prefill, compiled per prompt-length bucket (powers of two)
-        self._prefill = jax.jit(
-            partial(paged_prefill, n_heads=n_heads, n_layers=n_layers,
-                    compute_dtype=compute_dtype, n_kv_heads=n_kv,
-                    rope_theta=rope_theta),
-            donate_argnums=(1,))
+        if prefill_flash is None:
+            # auto: pallas flash attention for the FULL-PROMPT forward on
+            # TPU (O(T*block) VMEM instead of a dense (T, T) score
+            # materialization).  Scope: the start==0 un-chunked prefill
+            # only — chunked prefills and prefix-cache tails run
+            # paged_extend's gather attention, which has no flash analog
+            # here.  Probed once at a representative geometry; any
+            # unprobed per-bucket Mosaic rejection at runtime degrades to
+            # the dense prefill (see _do_prefill), never kills serving.
+            from tpulab.tpu.platform import is_tpu
+            prefill_flash = is_tpu() and _flash_compiles(
+                d_model // n_heads, compute_dtype, self.pool.device)
+        self.prefill_flash = bool(prefill_flash)
+        self._prefill_kw = dict(n_heads=n_heads, n_layers=n_layers,
+                                compute_dtype=compute_dtype,
+                                n_kv_heads=n_kv, rope_theta=rope_theta)
+        self._prefill = self._build_prefill(self.prefill_flash)
         # tail/chunk prefill against existing pool context (prefix-cache
         # hits, chunked long prompts) — compiled per tail-length bucket
         self._extend = jax.jit(
@@ -628,6 +666,19 @@ class ContinuousBatcher:
         self._thread = threading.Thread(target=self._run, name="cbatch",
                                         daemon=True)
         self._thread.start()
+
+    def _build_prefill(self, flash: bool):
+        """Jitted fused prefill, compiled per prompt-length bucket (powers
+        of two); ``flash`` selects the pallas prompt-attention kernel."""
+        import jax
+        attn_fn = None
+        if flash:
+            from tpulab.ops.flash_attention import make_flash_attention_fn
+            attn_fn = make_flash_attention_fn(causal=True)
+        return jax.jit(
+            partial(paged_prefill, attention_fn=attn_fn,
+                    **self._prefill_kw),
+            donate_argnums=(1,))
 
     # -- public -------------------------------------------------------------
     def submit(self, prompt, steps: int, on_token=None,
@@ -864,9 +915,26 @@ class ContinuousBatcher:
             t_pad = 1 << (t - 1).bit_length()  # pow2 bucket: small jit cache
             tokens = np.zeros((1, t_pad), np.int32)
             tokens[0, :t] = prompt
-            last_logits, self.pool.kv = self._prefill(
-                self.params, self.pool.kv, tables_j,
-                jnp.asarray(tokens), jnp.int32(t))
+            try:
+                last_logits, self.pool.kv = self._prefill(
+                    self.params, self.pool.kv, tables_j,
+                    jnp.asarray(tokens), jnp.int32(t))
+            except Exception:
+                if not self.prefill_flash:
+                    raise
+                # the one-geometry probe can't cover every pow2 bucket: a
+                # per-bucket Mosaic rejection (compile-time, so the donated
+                # pool is untouched) degrades this batcher to the dense
+                # prefill instead of failing requests
+                import logging
+                logging.getLogger("tpulab.engine").warning(
+                    "flash prefill failed at bucket %d; degrading this "
+                    "batcher to dense prefill", t_pad, exc_info=True)
+                self.prefill_flash = False
+                self._prefill = self._build_prefill(False)
+                last_logits, self.pool.kv = self._prefill(
+                    self.params, self.pool.kv, tables_j,
+                    jnp.asarray(tokens), jnp.int32(t))
         else:
             # tail (and/or chunked) prefill against resident context
             chunk = self.prefill_chunk or (t - start)
