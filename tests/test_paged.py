@@ -633,3 +633,71 @@ def test_prefill_flash_degrades_on_compile_failure(lm):
         assert cb.prefill_flash is False  # permanently degraded, once
     finally:
         cb.shutdown()
+
+
+def test_stop_tokens_end_generation_early(lm):
+    """A stop token ends the request at that tick (stop token included as
+    the final token), frees the lane, and rides the Generate RPC."""
+    import tpulab
+    from tpulab.models.mnist import make_mnist
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+
+    dense = make_generate_fn(lm, n_heads=2, n_layers=2, max_len=64,
+                             compute_dtype=jnp.float32)
+    p = np.random.default_rng(8).integers(0, 64, (5,), np.int32)
+    ref = list(np.asarray(dense(p[None, :], 10)[0]))
+    stop = ref[3]          # greedy run's 4th token becomes the stop token
+    want = ref[:ref.index(stop) + 1]
+
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=2, max_len=64,
+                           page_size=8, compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": cb})
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        got = cb.submit(p, 10, stop_tokens=[stop]).result(timeout=120)
+        assert list(got) == want
+        got_rpc = list(GenerateStreamClient(remote, "lm").generate(
+            p, 10, stop_tokens=[stop]))
+        assert got_rpc == want
+        # a stop token at the PREFILL-emitted first token also terminates
+        got1 = cb.submit(p, 10, stop_tokens=[ref[0]]).result(timeout=120)
+        assert list(got1) == ref[:1]
+    finally:
+        remote.close()
+        mgr.shutdown()
+        cb.shutdown()
+    assert cb.pool.free_pages == cb.pool.n_pages - 1
+
+
+def test_stop_tokens_on_dense_session_backend(lm):
+    """The dense session Generate path honors stop_tokens too (parity with
+    the paged backend)."""
+    import tpulab
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.mnist import make_mnist
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+
+    dense = make_generate_fn(lm, n_heads=2, n_layers=2, max_len=64,
+                             compute_dtype=jnp.float32)
+    p = np.random.default_rng(8).integers(0, 64, (5,), np.int32)
+    ref = list(np.asarray(dense(p[None, :], 10)[0]))
+    stop = ref[3]
+    eng = GenerationEngine(lm, n_heads=2, n_layers=2, max_len=64,
+                           max_sessions=1, compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": eng})
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        got = list(GenerateStreamClient(remote, "lm").generate(
+            p, 10, stop_tokens=[stop]))
+        assert got == ref[:ref.index(stop) + 1]
+    finally:
+        remote.close()
+        mgr.shutdown()

@@ -532,11 +532,12 @@ class SamplingParams:
 class _PagedRequest:
     __slots__ = ("prompt", "steps", "future", "tokens_out", "pages",
                  "length", "pending_prompt", "on_token", "cancelled",
-                 "sampling", "priority", "resumed", "admit_seq")
+                 "sampling", "priority", "resumed", "admit_seq",
+                 "stop_tokens")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
                  sampling: Optional[SamplingParams] = None,
-                 priority: int = 0):
+                 priority: int = 0, stop_tokens=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.steps = steps
         self.future: Future = Future()
@@ -551,6 +552,14 @@ class _PagedRequest:
         self.resumed = False     # preempted mid-decode; resume skips the
         #                          prefill pick (its token was already emitted)
         self.admit_seq = -1      # admission order (preemption tie-break)
+        self.stop_tokens = frozenset(int(t) for t in (stop_tokens or ()))
+
+    def finished(self) -> bool:
+        """steps exhausted, or the last emitted token is a stop token
+        (which stays in the output, ending it)."""
+        return bool(self.tokens_out) and (
+            len(self.tokens_out) >= self.steps
+            or self.tokens_out[-1] in self.stop_tokens)
 
 
 class ContinuousBatcher:
@@ -683,10 +692,13 @@ class ContinuousBatcher:
     # -- public -------------------------------------------------------------
     def submit(self, prompt, steps: int, on_token=None,
                sampling: Optional[SamplingParams] = None,
-               priority: int = 0) -> Future:
+               priority: int = 0, stop_tokens=None) -> Future:
         """``on_token(token, index)`` (optional) streams tokens as they
         decode — the hook the Generate RPC rides for paged serving.
         ``sampling`` selects the token policy (default greedy).
+        ``stop_tokens`` (iterable of token ids, e.g. the tokenizer's EOS)
+        ends generation early: the stop token is emitted as the final
+        token and the lane/pages free at that tick.
         ``priority`` orders admission (higher first; FIFO within a class)
         and arms preemption: a queued request strictly outranking an active
         one evicts it — the victim's pages free immediately and it resumes
@@ -700,7 +712,8 @@ class ContinuousBatcher:
         if n_prompt + steps > self.max_len:
             raise ValueError(f"prompt+steps exceeds max_len {self.max_len}")
         req = _PagedRequest(prompt, steps, on_token=on_token,
-                            sampling=sampling, priority=priority)
+                            sampling=sampling, priority=priority,
+                            stop_tokens=stop_tokens)
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("ContinuousBatcher is shut down")
@@ -849,7 +862,7 @@ class ContinuousBatcher:
                     with self._cv:
                         for lane, req in enumerate(self._active):
                             if (req is not None and not req.pending_prompt
-                                    and len(req.tokens_out) >= req.steps):
+                                    and req.finished()):
                                 self._release_lane_locked(lane, req)
                                 done_reqs.append(req)
                         self._admit_locked()
@@ -920,12 +933,17 @@ class ContinuousBatcher:
                     self.params, self.pool.kv, tables_j,
                     jnp.asarray(tokens), jnp.int32(t))
             except Exception:
-                if not self.prefill_flash:
-                    raise
                 # the one-geometry probe can't cover every pow2 bucket: a
                 # per-bucket Mosaic rejection (compile-time, so the donated
                 # pool is untouched) degrades this batcher to the dense
-                # prefill instead of failing requests
+                # prefill instead of failing requests.  An EXECUTION-time
+                # failure has already consumed the donated pool — re-raise
+                # to the scheduler's recovery path (fail actives + pool
+                # reset) rather than retrying against a deleted buffer.
+                if (not self.prefill_flash
+                        or getattr(self.pool.kv, "is_deleted",
+                                   lambda: False)()):
+                    raise
                 import logging
                 logging.getLogger("tpulab.engine").warning(
                     "flash prefill failed at bucket %d; degrading this "
@@ -1040,7 +1058,7 @@ class ContinuousBatcher:
                 req.tokens_out.append(int(next_tokens[lane]))
                 emits.append((req, req.tokens_out[-1],
                               len(req.tokens_out) - 1))
-                if len(req.tokens_out) >= req.steps:
+                if req.finished():
                     self._release_lane_locked(lane, req)
                     completed.append(req)
             self._admit_locked()

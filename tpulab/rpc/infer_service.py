@@ -382,6 +382,7 @@ class GenerateContext(StreamingContext):
                         "backend")))
             return
         try:
+            stops = set(request.stop_tokens)
             with engine.start_session(
                     timeout=self.SESSION_LEASE_TIMEOUT_S) as session:
                 session.prefill(np.asarray(request.prompt, np.int32))
@@ -392,6 +393,8 @@ class GenerateContext(StreamingContext):
                         log.info("generation cancelled by client at step %d", i)
                         return  # free the session slot immediately
                     self.write(pb.GenerateResponse(token=tok, index=i))
+                    if tok in stops:
+                        break  # stop token emitted; end like the paged path
             self.write(pb.GenerateResponse(
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
         except Exception as e:  # noqa: BLE001
@@ -423,7 +426,8 @@ class GenerateContext(StreamingContext):
             fut = engine.submit(np.asarray(request.prompt, np.int32),
                                 request.steps, on_token=on_token,
                                 sampling=sampling,
-                                priority=request.priority)
+                                priority=request.priority,
+                                stop_tokens=list(request.stop_tokens))
             deadline = _time.monotonic() + self.SESSION_LEASE_TIMEOUT_S
             while True:
                 try:
@@ -462,7 +466,8 @@ class GenerateStreamClient:
 
     def generate(self, prompt, steps: int, timeout: float = 300.0,
                  priority: int = 0, temperature: float = 0.0,
-                 top_k: int = 0, seed: Optional[int] = None):
+                 top_k: int = 0, seed: Optional[int] = None,
+                 stop_tokens=()):
         import queue as _q
         out: "_q.Queue" = _q.Queue()
         stream = ClientStreaming(
@@ -474,7 +479,8 @@ class GenerateStreamClient:
         req = pb.GenerateRequest(
             model_name=self.model_name,
             prompt=list(np.asarray(prompt, np.int32)), steps=steps,
-            priority=priority, temperature=temperature, top_k=top_k)
+            priority=priority, temperature=temperature, top_k=top_k,
+            stop_tokens=[int(t) for t in stop_tokens])
         if seed is not None:
             req.seed = seed
         stream.write(req)
