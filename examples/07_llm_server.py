@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""End-to-end LLM serving: the full paged stack behind one gRPC endpoint.
+
+Brings together every serving feature on a Llama-class model (random init,
+or a HF ``LlamaForCausalLM`` state_dict via --checkpoint): continuous
+batching over a paged KV pool, prefix caching, chunked prefill, priority
+scheduling + preemption, sampling, stop tokens, optional weight-only INT8
+and fp8 KV pages — served through the token-streaming Generate RPC.
+
+Server:
+    python examples/07_llm_server.py --cpu --port 50055
+Client (separate shell):
+    python examples/07_llm_server.py --cpu --connect localhost:50055 \
+        --prompt 1,2,3 --steps 16 --temperature 0.8 --seed 7
+
+The reference has no LLM serving (trtlab predates it); this example is the
+"switch from the reference" landing spot for generative workloads — the
+same Server/AsyncService machinery as examples/02, different payload.
+"""
+
+import argparse
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--port", type=int, default=50055)
+    ap.add_argument("--connect", default="",
+                    help="client mode: host:port of a running server")
+    ap.add_argument("--checkpoint", default="",
+                    help="optional torch .pt/.pth LlamaForCausalLM state_dict")
+    ap.add_argument("--vocab", type=int, default=512)
+    ap.add_argument("--d-model", type=int, default=256)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=512)
+    ap.add_argument("--lanes", type=int, default=8)
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only INT8 (W8A16)")
+    ap.add_argument("--kv-fp8", action="store_true",
+                    help="fp8 e4m3 KV pages")
+    ap.add_argument("--rope-theta", type=float, default=10000.0,
+                    help="RoPE base (MUST match the checkpoint's config, "
+                         "e.g. 500000 for Llama-3-class models)")
+    # client-mode options
+    ap.add_argument("--prompt", default="1,2,3,4")
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--priority", type=int, default=0)
+    ap.add_argument("--stop-token", type=int, default=None)
+    ap.add_argument("--oneshot", action="store_true",
+                    help="server exits after first client disconnect (tests)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        from tpulab.tpu.platform import force_cpu
+        force_cpu(1)
+    import numpy as np
+
+    if args.connect:
+        from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                              RemoteInferenceManager)
+        remote = RemoteInferenceManager(args.connect)
+        prompt = np.asarray([int(t) for t in args.prompt.split(",")],
+                            np.int32)
+        client = GenerateStreamClient(remote, "llm")
+        stops = [args.stop_token] if args.stop_token is not None else ()
+        for tok in client.generate(prompt, args.steps,
+                                   temperature=args.temperature,
+                                   seed=args.seed, priority=args.priority,
+                                   stop_tokens=stops):
+            print(tok, end=" ", flush=True)
+        print("\ndone")
+        remote.close()
+        return 0
+
+    import jax.numpy as jnp
+
+    import tpulab
+    from tpulab.engine.paged import ContinuousBatcher
+    from tpulab.models.transformer import init_transformer_params
+
+    rope_theta = args.rope_theta
+    if args.checkpoint:
+        import torch
+
+        from tpulab.models.torch_import import llama_params_from_torch
+        sd = torch.load(args.checkpoint, map_location="cpu",
+                        weights_only=True)
+        params = llama_params_from_torch(sd)
+        # head geometry comes from the HF config — pass it on the CLI
+        # (--heads/--kv-heads must match the checkpoint)
+        layers = len([k for k in params if k.startswith("layer")])
+        heads, kv_heads = args.heads, args.kv_heads
+    else:
+        params = init_transformer_params(
+            vocab=args.vocab, d_model=args.d_model, n_heads=args.heads,
+            n_layers=args.layers, d_ff=4 * args.d_model,
+            n_kv_heads=args.kv_heads, tie_embeddings=False)
+        heads, kv_heads, layers = args.heads, args.kv_heads, args.layers
+
+    if args.int8:
+        from tpulab.models.quantization import quantize_transformer_params
+        params = quantize_transformer_params(params)
+
+    cb = ContinuousBatcher(
+        params, n_heads=heads, n_layers=layers, n_kv_heads=kv_heads,
+        lanes=args.lanes, max_len=args.max_len, rope_theta=rope_theta,
+        prefix_cache=True, prefill_chunk=256,
+        kv_dtype=jnp.float8_e4m3fn if args.kv_fp8 else None)
+
+    # generation-only deployment: no dense models, just the Generate RPC
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.serve(port=args.port, generation_engines={"llm": cb})
+    print(f"LLM server on :{mgr.server.bound_port} "
+          f"(lanes={args.lanes} max_len={args.max_len} "
+          f"int8={args.int8} kv_fp8={args.kv_fp8} "
+          f"kernel={cb.use_kernel} flash_prefill={cb.prefill_flash})",
+          flush=True)
+    import time
+    try:
+        if args.oneshot:
+            # completed_requests is edge-proof (a fast generation can start
+            # AND finish between active_lanes polls)
+            while cb.completed_requests == 0:
+                time.sleep(0.1)
+            time.sleep(2.0)  # let the final stream frames flush
+        else:
+            while True:
+                time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        mgr.shutdown()
+        cb.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -257,3 +257,42 @@ def test_notebook_llm_serving():
     assert "streamed as decoded" in out.stdout
     assert "page hits" in out.stdout
     assert out.stdout.strip().endswith("done")
+
+
+def test_07_llm_server_end_to_end():
+    """The LLM server example: int8 weights + fp8 KV + prefix cache behind
+    the Generate RPC, driven by its own client mode across processes."""
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "TPULAB_FORCE_CPU": "1", "HOME": "/tmp"}
+    srv = subprocess.Popen(
+        [sys.executable, f"{REPO}/examples/07_llm_server.py", "--cpu",
+         "--port", "0", "--oneshot", "--int8", "--kv-fp8",
+         "--max-len", "128", "--lanes", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        port = None
+        seen = []
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if srv.poll() is not None:
+                raise AssertionError(
+                    "server died at startup:\n" + "".join(seen))
+            line = srv.stdout.readline()
+            seen.append(line)
+            if "LLM server on :" in line:
+                port = line.split("LLM server on :")[1].split()[0]
+                break
+        assert port, "server never came up:\n" + "".join(seen)
+        out = subprocess.run(
+            [sys.executable, f"{REPO}/examples/07_llm_server.py", "--cpu",
+             "--connect", f"localhost:{port}", "--prompt", "5,6,7",
+             "--steps", "6", "--temperature", "0.7", "--seed", "3"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        toks = out.stdout.split("\n")[0].split()
+        assert len(toks) == 6 and out.stdout.strip().endswith("done")
+        assert srv.wait(timeout=60) == 0  # oneshot exit
+    finally:
+        srv.kill()

@@ -42,7 +42,8 @@ class InferenceManager(_EngineManager):
         ``generation_engines={name: GenerationEngine}`` serves token
         streaming over the Generate RPC."""
         if not self._allocated:
-            self.update_resources()
+            # generation-only serving needs no dense models
+            self.update_resources(allow_empty=bool(generation_engines))
         self._server = build_infer_service(
             self, f"0.0.0.0:{port}", executor=executor, batching=batching,
             batch_window_s=batch_window_s, metrics=metrics,

@@ -94,12 +94,16 @@ class InferenceManager:
                 ExecutionContext(compiled, slot_id=i) for i in range(slots))
 
     # -- resource allocation (reference AllocateResources :181-205) ---------
-    def update_resources(self) -> None:
-        if not self._models:
+    def update_resources(self, allow_empty: bool = False) -> None:
+        """``allow_empty`` permits a manager with no dense models —
+        generation-only deployments (Generate RPC engines attach at
+        serve() time) need the service plumbing but no staging pools."""
+        if not self._models and not allow_empty:
             raise RuntimeError("no models registered")
         # max-reduce staging bytes over models (reference :110-117), with
         # 128KiB headroom per bundle for alignment carve-out
-        stack_bytes = max(m.bindings_size_in_bytes() for m in self._models.values())
+        stack_bytes = max((m.bindings_size_in_bytes()
+                           for m in self._models.values()), default=0)
         stack_bytes += 128 * 1024
         from tpulab.tpu.sync import EventPoller
         from tpulab.tpu.transfer import TransferEngine

@@ -670,6 +670,7 @@ class ContinuousBatcher:
         self._active: List[Optional[_PagedRequest]] = [None] * lanes
         self._admit_counter = 0
         self.preemptions = 0
+        self.completed_requests = 0  # futures resolved successfully
         self._cv = threading.Condition()
         self._shutdown = False
         self._thread = threading.Thread(target=self._run, name="cbatch",
@@ -871,6 +872,7 @@ class ContinuousBatcher:
                         if not req.future.done():
                             req.future.set_result(
                                 list(req.tokens_out[:req.steps]))
+                            self.completed_requests += 1
                 progressed = self._tick(snapshot, jnp) or prefilled
                 if not progressed:
                     # every lane starved (pool pressure): back off instead
@@ -1069,6 +1071,7 @@ class ContinuousBatcher:
         for req in completed:
             if not req.future.done():
                 req.future.set_result(list(req.tokens_out[:req.steps]))
+                self.completed_requests += 1
         return True
 
     def _release_lane_locked(self, lane: int, req: _PagedRequest) -> None:
