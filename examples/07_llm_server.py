@@ -51,6 +51,8 @@ def main():
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--priority", type=int, default=0)
     ap.add_argument("--stop-token", type=int, default=None)
+    ap.add_argument("--device-sampling", action="store_true",
+                    help="temperature sampling computed on-chip")
     ap.add_argument("--oneshot", action="store_true",
                     help="server exits after first client disconnect (tests)")
     args = ap.parse_args()
@@ -71,7 +73,8 @@ def main():
         for tok in client.generate(prompt, args.steps,
                                    temperature=args.temperature,
                                    seed=args.seed, priority=args.priority,
-                                   stop_tokens=stops):
+                                   stop_tokens=stops,
+                                   device_sampling=args.device_sampling):
             print(tok, end=" ", flush=True)
         print("\ndone")
         remote.close()

@@ -701,3 +701,112 @@ def test_stop_tokens_on_dense_session_backend(lm):
     finally:
         remote.close()
         mgr.shutdown()
+
+
+def test_device_sampling_reproducible_and_batch_invariant(lm):
+    """device=True sampling: the (seed, position)-folded on-chip stream is
+    reproducible across engines, invariant to batch-mates, unperturbed by
+    preemption, and never fetches logits for those lanes."""
+    import threading
+    p = np.random.default_rng(6).integers(0, 64, (5,), np.int32)
+
+    def run(extra_traffic=False, preempt=False):
+        cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=2,
+                               max_len=64, page_size=8,
+                               compute_dtype=jnp.float32)
+        try:
+            started = threading.Event()
+            fut = cb.submit(p, 10,
+                            sampling=SamplingParams(temperature=0.9,
+                                                    seed=1234, device=True),
+                            on_token=lambda t, i: started.set())
+            if extra_traffic:
+                cb.submit(np.full((3,), 7, np.int32), 10,
+                          sampling=SamplingParams(temperature=1.5, seed=9,
+                                                  device=True))
+            if preempt:
+                assert started.wait(timeout=60)
+                cb.submit(np.full((4,), 2, np.int32), 3, priority=10
+                          ).result(timeout=120)
+            return list(fut.result(timeout=120))
+        finally:
+            cb.shutdown()
+
+    base = run()
+    assert run(extra_traffic=True) == base
+    assert run(preempt=True) == base
+    assert len(base) == 10
+
+
+def test_device_sampling_rejects_top_k(lm):
+    with pytest.raises(ValueError, match="top_k"):
+        SamplingParams(temperature=0.5, top_k=10, device=True)
+
+
+def test_device_and_host_sampling_coexist(lm):
+    """A tick mixing greedy, device-sampled, and host-sampled lanes keeps
+    every stream independent and correct."""
+    dense = make_generate_fn(lm, n_heads=2, n_layers=2, max_len=64,
+                             compute_dtype=jnp.float32)
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=3, max_len=64,
+                           page_size=8, compute_dtype=jnp.float32)
+    ref_cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=1,
+                               max_len=64, page_size=8,
+                               compute_dtype=jnp.float32)
+    try:
+        pg = np.random.default_rng(1).integers(0, 64, (4,), np.int32)
+        ph = np.random.default_rng(2).integers(0, 64, (4,), np.int32)
+        pd = np.random.default_rng(3).integers(0, 64, (4,), np.int32)
+        host_ref = ref_cb.submit(
+            ph, 8, sampling=SamplingParams(temperature=0.8, top_k=8,
+                                           seed=55)).result(timeout=120)
+        futs = [
+            cb.submit(pg, 8),                                     # greedy
+            cb.submit(ph, 8, sampling=SamplingParams(
+                temperature=0.8, top_k=8, seed=55)),              # host
+            cb.submit(pd, 8, sampling=SamplingParams(
+                temperature=0.8, seed=77, device=True)),          # device
+        ]
+        outs = [f.result(timeout=120) for f in futs]
+        np.testing.assert_array_equal(
+            np.asarray(outs[0]), np.asarray(dense(pg[None, :], 8)[0]))
+        assert list(outs[1]) == list(host_ref)
+        assert len(outs[2]) == 8
+    finally:
+        cb.shutdown()
+        ref_cb.shutdown()
+
+
+def test_generate_rpc_device_sampling(lm):
+    """device_sampling over the wire: seeded remote run == local seeded
+    device-sampled run; invalid top_k combo is a clean error."""
+    import tpulab
+    from tpulab.models.mnist import make_mnist
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+
+    cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=2, max_len=32,
+                           page_size=8, compute_dtype=jnp.float32)
+    ref_cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=1,
+                               max_len=32, page_size=8,
+                               compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": cb})
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        p = np.random.default_rng(4).integers(0, 64, (6,), np.int32)
+        want = ref_cb.submit(p, 6, sampling=SamplingParams(
+            temperature=0.8, seed=321, device=True)).result(timeout=120)
+        got = list(GenerateStreamClient(remote, "lm").generate(
+            p, 6, temperature=0.8, seed=321, device_sampling=True))
+        assert got == list(want)
+        with pytest.raises(RuntimeError, match="top_k"):
+            list(GenerateStreamClient(remote, "lm").generate(
+                p, 4, temperature=0.8, top_k=5, device_sampling=True))
+    finally:
+        remote.close()
+        mgr.shutdown()
+        cb.shutdown()
+        ref_cb.shutdown()

@@ -229,7 +229,8 @@ def paged_decode_step(params, kv_pool, tables, lengths, tokens,
                       active, n_heads: int, n_layers: int,
                       compute_dtype, use_kernel: bool = False,
                       n_kv_heads: Optional[int] = None,
-                      rope_theta: Optional[float] = None):
+                      rope_theta: Optional[float] = None,
+                      temps=None, seeds=None):
     """One batched decode tick over the paged pool.
 
     Shapes: kv_pool (L, P, 2, S, Hkv, D) fused page store (axis 2 = K/V),
@@ -238,6 +239,13 @@ def paged_decode_step(params, kv_pool, tables, lengths, tokens,
     Returns (logits (B, vocab), kv_pool) — the pool donated by the caller.
     Under GQA (``n_kv_heads < n_heads``) the pool holds ``n_kv_heads``
     heads per slot.
+
+    With ``temps (B,) f32`` + ``seeds (B,) uint32`` the return becomes
+    (next_tokens (B,) i32, logits, kv_pool): lanes with temp > 0 are
+    Gumbel-max temperature-sampled ON DEVICE with a key folded from
+    (seed, position) — batch-composition- and preemption-invariant — and
+    temp == 0 lanes take the argmax.  Callers then fetch only the (B,)
+    token ids (no per-tick (B, vocab) logits transfer).
     """
     import jax.numpy as jnp
     from tpulab.models.transformer import (_dense_ffn, _lm_head, _rmsnorm,
@@ -293,7 +301,30 @@ def paged_decode_step(params, kv_pool, tables, lengths, tokens,
     logits = _lm_head(params, x[:, 0])
     # inactive lanes emit neutral logits (argmax 0) — callers mask on active
     logits = jnp.where(active[:, None], logits, 0.0)
-    return logits, kv_pool
+    if temps is None:
+        return logits, kv_pool
+    import jax
+    next_tokens = jax.vmap(_device_sample_token)(
+        logits, temps, seeds.astype(jnp.uint32), lengths)
+    return next_tokens, logits, kv_pool
+
+
+def _device_sample_token(row, temp, seed2, pos):
+    """Gumbel-max temperature sample of one lane: key folded from the full
+    64-bit seed (lo, hi words) and the token position — the SINGLE
+    definition of the device-sampling stream (the decode step vmaps it;
+    the prefill first-token pick replays it on the fetched logits row so
+    one request is one stream end to end)."""
+    import jax
+    import jax.numpy as jnp
+    key = jax.random.fold_in(
+        jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(0), seed2[0]), seed2[1]),
+        pos)
+    g = jax.random.gumbel(key, row.shape, jnp.float32)
+    safe_t = jnp.where(temp > 0, temp, 1.0)
+    sampled = jnp.argmax(row / safe_t + g)
+    return jnp.where(temp > 0, sampled, jnp.argmax(row)).astype(jnp.int32)
 
 
 def paged_prefill(params, kv_pool, tables, tokens, valid_len,
@@ -502,17 +533,38 @@ class PrefixCache:
 
 
 class SamplingParams:
-    """Host-side token selection policy (greedy by default; temperature /
-    top-k sampling with a per-request PRNG for reproducibility)."""
+    """Token selection policy (greedy by default).
 
-    __slots__ = ("temperature", "top_k", "_rng")
+    ``device=False`` (default): host-side temperature / top-k sampling
+    with a per-request numpy PRNG — requires fetching the lane's full
+    (vocab,) logits row every tick.
+
+    ``device=True``: TPU-first temperature sampling computed ON CHIP
+    (Gumbel-max over the logits with a per-lane key folded from
+    (seed, position)) — the tick fetches only (B,) token ids, never the
+    logits.  Reproducible per request (the key depends only on seed and
+    position, not batch-mates or preemption) but a DIFFERENT stream than
+    the host PRNG.  ``top_k`` is a host-side feature: device=True with
+    top_k > 0 is rejected (per-lane k cannot be a static compile-time
+    shape).
+    """
+
+    __slots__ = ("temperature", "top_k", "device", "seed", "_rng")
 
     def __init__(self, temperature: float = 0.0, top_k: int = 0,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, device: bool = False):
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
+        if device and top_k > 0:
+            raise ValueError("device sampling does not support top_k "
+                             "(per-lane k is not a static shape); use "
+                             "host sampling for top-k")
         self.temperature = temperature
         self.top_k = top_k
+        self.device = device
+        if seed is None:
+            seed = int(np.random.default_rng().integers(0, 2**31 - 1))
+        self.seed = int(seed)
         self._rng = np.random.default_rng(seed)
 
     def pick(self, logits: np.ndarray) -> int:
@@ -978,7 +1030,23 @@ class ContinuousBatcher:
             # logits, consume no PRNG state, just continue decoding
             req.resumed = False
         else:
-            tok = req.sampling.pick(np.asarray(last_logits))
+            sp = req.sampling
+            if sp.device and sp.temperature > 0.0:
+                # first token rides the SAME (seed, position) stream as the
+                # decode ticks (position t-1 = the last prompt token's
+                # query; decode ticks start at position t) — one request is
+                # one reproducible stream end to end.  The prefill logits
+                # row is fetched once per request; per-TICK logits are
+                # never fetched for device-sampled lanes.
+                import jax.numpy as _j
+                tok = int(np.asarray(_device_sample_token(
+                    _j.asarray(last_logits, _j.float32),
+                    _j.float32(sp.temperature),
+                    _j.asarray([sp.seed & 0xFFFFFFFF,
+                                (sp.seed >> 32) & 0xFFFFFFFF], _j.uint32),
+                    _j.int32(t - 1))))
+            else:
+                tok = sp.pick(np.asarray(last_logits))
             req.tokens_out.append(tok)
             self._emit(req, tok, 0)
         if self.prefix_cache is not None and not was_resumed:
@@ -1026,25 +1094,47 @@ class ContinuousBatcher:
 
         if not active.any():
             return False
-        logits, self.pool.kv = self._step(
-            self.params, self.pool.kv,
-            jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(tokens),
-            jnp.asarray(active))
-        # greedy lanes ride a device-side argmax; sampling lanes pull their
-        # logits row and pick host-side (per-request PRNG)
-        all_greedy = all(req is None or req.sampling.temperature == 0.0
-                         for req in snapshot)
-        if all_greedy:
-            next_tokens = np.asarray(logits.argmax(-1), np.int32)
+        # device-sampled lanes carry their temperature into the step (the
+        # tick then fetches only (B,) token ids for them); host-sampled
+        # (top_k) lanes keep temp 0 on device and pick from fetched logits
+        temps = np.zeros((self.lanes,), np.float32)
+        seeds = np.zeros((self.lanes, 2), np.uint32)   # (lo, hi) words
+        host_lanes = []
+        for lane, req in enumerate(snapshot):
+            if req is None or not active[lane]:
+                continue
+            sp = req.sampling
+            if sp.temperature > 0.0:
+                if sp.device:
+                    temps[lane] = sp.temperature
+                    seeds[lane] = (sp.seed & 0xFFFFFFFF,
+                                   (sp.seed >> 32) & 0xFFFFFFFF)
+                else:
+                    host_lanes.append(lane)
+        if temps.any():
+            tok_dev, logits, self.pool.kv = self._step(
+                self.params, self.pool.kv,
+                jnp.asarray(tables), jnp.asarray(lengths),
+                jnp.asarray(tokens), jnp.asarray(active),
+                temps=jnp.asarray(temps), seeds=jnp.asarray(seeds))
+            # greedy + device-sampled lanes: ONLY (B,) ids cross the link
+            next_tokens = np.asarray(tok_dev, np.int32).copy()
         else:
+            # no device-sampled lane this tick: the plain signature (jit
+            # specializes on temps=None) — greedy stays one device argmax
+            logits, self.pool.kv = self._step(
+                self.params, self.pool.kv,
+                jnp.asarray(tables), jnp.asarray(lengths),
+                jnp.asarray(tokens), jnp.asarray(active))
+            next_tokens = np.asarray(logits.argmax(-1), np.int32).copy()
+        if host_lanes:
             logits_host = np.asarray(logits)
-            # only active lanes consume PRNG state: a page-starved or
-            # pending-prefill lane must not perturb a seeded request's
-            # token sequence (per-request reproducibility)
-            next_tokens = np.asarray(
-                [req.sampling.pick(logits_host[lane])
-                 if req is not None and active[lane]
-                 else 0 for lane, req in enumerate(snapshot)], np.int32)
+            # only active host-sampled lanes consume PRNG state: a
+            # page-starved or pending-prefill lane must not perturb a
+            # seeded request's token sequence (per-request reproducibility)
+            for lane in host_lanes:
+                next_tokens[lane] = snapshot[lane].sampling.pick(
+                    logits_host[lane])
 
         emits: List = []
         completed: List = []

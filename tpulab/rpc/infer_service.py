@@ -361,6 +361,12 @@ class GenerateContext(StreamingContext):
                 code=pb.UNKNOWN_MODEL,
                 message=f"no generation engine for {request.model_name!r}")))
             return
+        if request.device_sampling and request.top_k > 0:
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT,
+                message="device_sampling does not support top_k (host-side "
+                        "feature)")))
+            return
         if not (request.temperature >= 0.0):  # rejects negatives AND NaN
             # mirror SamplingParams' local contract instead of silently
             # coercing a sign bug to greedy
@@ -422,7 +428,8 @@ class GenerateContext(StreamingContext):
                 from tpulab.engine.paged import SamplingParams
                 sampling = SamplingParams(
                     temperature=request.temperature, top_k=request.top_k,
-                    seed=request.seed if request.HasField("seed") else None)
+                    seed=request.seed if request.HasField("seed") else None,
+                    device=request.device_sampling)
             fut = engine.submit(np.asarray(request.prompt, np.int32),
                                 request.steps, on_token=on_token,
                                 sampling=sampling,
@@ -467,7 +474,7 @@ class GenerateStreamClient:
     def generate(self, prompt, steps: int, timeout: float = 300.0,
                  priority: int = 0, temperature: float = 0.0,
                  top_k: int = 0, seed: Optional[int] = None,
-                 stop_tokens=()):
+                 stop_tokens=(), device_sampling: bool = False):
         import queue as _q
         out: "_q.Queue" = _q.Queue()
         stream = ClientStreaming(
@@ -480,7 +487,8 @@ class GenerateStreamClient:
             model_name=self.model_name,
             prompt=list(np.asarray(prompt, np.int32)), steps=steps,
             priority=priority, temperature=temperature, top_k=top_k,
-            stop_tokens=[int(t) for t in stop_tokens])
+            stop_tokens=[int(t) for t in stop_tokens],
+            device_sampling=device_sampling)
         if seed is not None:
             req.seed = seed
         stream.write(req)
