@@ -240,12 +240,14 @@ def paged_decode_step(params, kv_pool, tables, lengths, tokens,
     Under GQA (``n_kv_heads < n_heads``) the pool holds ``n_kv_heads``
     heads per slot.
 
-    With ``temps (B,) f32`` + ``seeds (B,) uint32`` the return becomes
-    (next_tokens (B,) i32, logits, kv_pool): lanes with temp > 0 are
-    Gumbel-max temperature-sampled ON DEVICE with a key folded from
-    (seed, position) — batch-composition- and preemption-invariant — and
-    temp == 0 lanes take the argmax.  Callers then fetch only the (B,)
-    token ids (no per-tick (B, vocab) logits transfer).
+    With ``temps (B,) f32`` + ``seeds (B, 2) uint32`` the return becomes
+    (next_tokens (B,) i32, logprobs (B,) f32, logits, kv_pool): lanes
+    with temp > 0 are Gumbel-max temperature-sampled ON DEVICE with a key
+    folded from (seed, position) — batch-composition- and
+    preemption-invariant — and temp == 0 lanes take the argmax;
+    ``logprobs`` is each lane's chosen-token log-probability
+    (log-softmax at the chosen id).  Callers then fetch only (B,)-sized
+    arrays (no per-tick (B, vocab) logits transfer).
     """
     import jax.numpy as jnp
     from tpulab.models.transformer import (_dense_ffn, _lm_head, _rmsnorm,
@@ -306,7 +308,10 @@ def paged_decode_step(params, kv_pool, tables, lengths, tokens,
     import jax
     next_tokens = jax.vmap(_device_sample_token)(
         logits, temps, seeds.astype(jnp.uint32), lengths)
-    return next_tokens, logits, kv_pool
+    logp_rows = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    logprobs = jnp.take_along_axis(logp_rows, next_tokens[:, None],
+                                   axis=-1)[:, 0]
+    return next_tokens, logprobs, logits, kv_pool
 
 
 def _device_sample_token(row, temp, seed2, pos):
@@ -585,11 +590,12 @@ class _PagedRequest:
     __slots__ = ("prompt", "steps", "future", "tokens_out", "pages",
                  "length", "pending_prompt", "on_token", "cancelled",
                  "sampling", "priority", "resumed", "admit_seq",
-                 "stop_tokens")
+                 "stop_tokens", "want_logprobs", "logprobs_out")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
                  sampling: Optional[SamplingParams] = None,
-                 priority: int = 0, stop_tokens=None):
+                 priority: int = 0, stop_tokens=None,
+                 logprobs: bool = False):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.steps = steps
         self.future: Future = Future()
@@ -605,6 +611,8 @@ class _PagedRequest:
         #                          prefill pick (its token was already emitted)
         self.admit_seq = -1      # admission order (preemption tie-break)
         self.stop_tokens = frozenset(int(t) for t in (stop_tokens or ()))
+        self.want_logprobs = logprobs
+        self.logprobs_out: List[float] = []
 
     def finished(self) -> bool:
         """steps exhausted, or the last emitted token is a stop token
@@ -745,10 +753,15 @@ class ContinuousBatcher:
     # -- public -------------------------------------------------------------
     def submit(self, prompt, steps: int, on_token=None,
                sampling: Optional[SamplingParams] = None,
-               priority: int = 0, stop_tokens=None) -> Future:
+               priority: int = 0, stop_tokens=None,
+               logprobs: bool = False) -> Future:
         """``on_token(token, index)`` (optional) streams tokens as they
         decode — the hook the Generate RPC rides for paged serving.
         ``sampling`` selects the token policy (default greedy).
+        ``logprobs=True`` resolves the future to ``(tokens, logprobs)``
+        (each token's chosen log-probability, computed on device) instead
+        of the plain token list, and ``on_token`` is then called with a
+        third ``logprob`` argument.
         ``stop_tokens`` (iterable of token ids, e.g. the tokenizer's EOS)
         ends generation early: the stop token is emitted as the final
         token and the lane/pages free at that tick.
@@ -766,7 +779,7 @@ class ContinuousBatcher:
             raise ValueError(f"prompt+steps exceeds max_len {self.max_len}")
         req = _PagedRequest(prompt, steps, on_token=on_token,
                             sampling=sampling, priority=priority,
-                            stop_tokens=stop_tokens)
+                            stop_tokens=stop_tokens, logprobs=logprobs)
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("ContinuousBatcher is shut down")
@@ -922,8 +935,7 @@ class ContinuousBatcher:
                         snapshot = list(self._active)
                     for req in done_reqs:
                         if not req.future.done():
-                            req.future.set_result(
-                                list(req.tokens_out[:req.steps]))
+                            req.future.set_result(self._result_of(req))
                             self.completed_requests += 1
                 progressed = self._tick(snapshot, jnp) or prefilled
                 if not progressed:
@@ -1048,7 +1060,13 @@ class ContinuousBatcher:
             else:
                 tok = sp.pick(np.asarray(last_logits))
             req.tokens_out.append(tok)
-            self._emit(req, tok, 0)
+            lp = None
+            if req.want_logprobs:
+                row = np.asarray(last_logits, np.float64)
+                row = row - row.max()
+                lp = float(row[tok] - np.log(np.exp(row).sum()))
+                req.logprobs_out.append(lp)
+            self._emit(req, tok, 0, lp)
         if self.prefix_cache is not None and not was_resumed:
             # count each logical request once (resume prefills re-walk
             # already-counted pages) and publish only first-prefill pages:
@@ -1060,10 +1078,18 @@ class ContinuousBatcher:
         return True
 
     @staticmethod
-    def _emit(req: _PagedRequest, token: int, index: int) -> None:
+    def _emit(req: _PagedRequest, token: int, index: int,
+              logprob: Optional[float] = None) -> None:
+        """Explicit hook contract: ``on_token(tok, i)`` normally;
+        ``on_token(tok, i, logprob)`` iff the request asked for
+        ``logprobs=True`` (no signature sniffing — a 3-arg call on a
+        2-arg hook with ``logprobs=True`` is a caller bug and raises)."""
         if req.on_token is not None:
             try:
-                req.on_token(token, index)
+                if req.want_logprobs:
+                    req.on_token(token, index, logprob)
+                else:
+                    req.on_token(token, index)
             except Exception:  # pragma: no cover - consumer hook
                 import logging
                 logging.getLogger("tpulab.engine").exception(
@@ -1100,9 +1126,11 @@ class ContinuousBatcher:
         temps = np.zeros((self.lanes,), np.float32)
         seeds = np.zeros((self.lanes, 2), np.uint32)   # (lo, hi) words
         host_lanes = []
+        want_logp = False
         for lane, req in enumerate(snapshot):
             if req is None or not active[lane]:
                 continue
+            want_logp |= req.want_logprobs
             sp = req.sampling
             if sp.temperature > 0.0:
                 if sp.device:
@@ -1111,17 +1139,21 @@ class ContinuousBatcher:
                                    (sp.seed >> 32) & 0xFFFFFFFF)
                 else:
                     host_lanes.append(lane)
-        if temps.any():
-            tok_dev, logits, self.pool.kv = self._step(
+        logprobs_arr = None
+        if temps.any() or want_logp:
+            tok_dev, logp_dev, logits, self.pool.kv = self._step(
                 self.params, self.pool.kv,
                 jnp.asarray(tables), jnp.asarray(lengths),
                 jnp.asarray(tokens), jnp.asarray(active),
                 temps=jnp.asarray(temps), seeds=jnp.asarray(seeds))
-            # greedy + device-sampled lanes: ONLY (B,) ids cross the link
+            # greedy + device-sampled lanes: ONLY (B,)-sized arrays cross
+            # the link (token ids + chosen-token logprobs)
             next_tokens = np.asarray(tok_dev, np.int32).copy()
+            logprobs_arr = np.asarray(logp_dev, np.float32).copy()
         else:
-            # no device-sampled lane this tick: the plain signature (jit
-            # specializes on temps=None) — greedy stays one device argmax
+            # neither device sampling nor logprobs this tick: the plain
+            # signature (jit specializes on temps=None) — greedy stays one
+            # device argmax
             logits, self.pool.kv = self._step(
                 self.params, self.pool.kv,
                 jnp.asarray(tables), jnp.asarray(lengths),
@@ -1135,6 +1167,12 @@ class ContinuousBatcher:
             for lane in host_lanes:
                 next_tokens[lane] = snapshot[lane].sampling.pick(
                     logits_host[lane])
+                if logprobs_arr is not None:
+                    row = logits_host[lane].astype(np.float64)
+                    row = row - row.max()
+                    logprobs_arr[lane] = float(
+                        row[next_tokens[lane]]
+                        - np.log(np.exp(row).sum()))
 
         emits: List = []
         completed: List = []
@@ -1148,21 +1186,32 @@ class ContinuousBatcher:
                     continue
                 req.length += 1
                 req.tokens_out.append(int(next_tokens[lane]))
+                lp = (float(logprobs_arr[lane])
+                      if logprobs_arr is not None else None)
+                if req.want_logprobs:
+                    req.logprobs_out.append(lp)
                 emits.append((req, req.tokens_out[-1],
-                              len(req.tokens_out) - 1))
+                              len(req.tokens_out) - 1, lp))
                 if req.finished():
                     self._release_lane_locked(lane, req)
                     completed.append(req)
             self._admit_locked()
         # user callbacks and future resolution OUTSIDE the scheduler lock:
         # a slow consumer must not head-of-line-block other lanes
-        for req, tok, i in emits:
-            self._emit(req, tok, i)
+        for req, tok, i, lp in emits:
+            self._emit(req, tok, i, lp)
         for req in completed:
             if not req.future.done():
-                req.future.set_result(list(req.tokens_out[:req.steps]))
+                req.future.set_result(self._result_of(req))
                 self.completed_requests += 1
         return True
+
+    @staticmethod
+    def _result_of(req: _PagedRequest):
+        toks = list(req.tokens_out[:req.steps])
+        if req.want_logprobs:
+            return toks, list(req.logprobs_out[:len(toks)])
+        return toks
 
     def _release_lane_locked(self, lane: int, req: _PagedRequest) -> None:
         self.pool.release_pages(req.pages)

@@ -377,14 +377,15 @@ class GenerateContext(StreamingContext):
         if getattr(engine, "continuous_batching", False):  # explicit marker
             self._run_paged(engine, request)
             return
-        if request.temperature > 0.0 or request.priority != 0:
+        if (request.temperature > 0.0 or request.priority != 0
+                or request.return_logprobs):
             # the dense session engine is greedy/FIFO only — reject rather
             # than silently returning greedy tokens for a sampled request
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
                 code=pb.INVALID_ARGUMENT,
                 message=f"model {request.model_name!r} is served by a dense "
-                        "session engine: sampling (temperature/top_k/seed) "
-                        "and priority require a continuous-batching "
+                        "session engine: sampling (temperature/top_k/seed), "
+                        "priority and logprobs require a continuous-batching "
                         "backend")))
             return
         try:
@@ -417,9 +418,11 @@ class GenerateContext(StreamingContext):
         import time as _time
         finished = [False]
 
-        def on_token(tok, i):
+        def on_token(tok, i, logprob=None):
             if not finished[0]:
-                self.write(pb.GenerateResponse(token=tok, index=i))
+                self.write(pb.GenerateResponse(
+                    token=tok, index=i,
+                    logprob=0.0 if logprob is None else float(logprob)))
 
         fut = None
         try:
@@ -434,7 +437,8 @@ class GenerateContext(StreamingContext):
                                 request.steps, on_token=on_token,
                                 sampling=sampling,
                                 priority=request.priority,
-                                stop_tokens=list(request.stop_tokens))
+                                stop_tokens=list(request.stop_tokens),
+                                logprobs=request.return_logprobs)
             deadline = _time.monotonic() + self.SESSION_LEASE_TIMEOUT_S
             while True:
                 try:
@@ -474,7 +478,10 @@ class GenerateStreamClient:
     def generate(self, prompt, steps: int, timeout: float = 300.0,
                  priority: int = 0, temperature: float = 0.0,
                  top_k: int = 0, seed: Optional[int] = None,
-                 stop_tokens=(), device_sampling: bool = False):
+                 stop_tokens=(), device_sampling: bool = False,
+                 return_logprobs: bool = False):
+        """Yields token ids; with ``return_logprobs=True`` yields
+        ``(token, logprob)`` pairs instead."""
         import queue as _q
         out: "_q.Queue" = _q.Queue()
         stream = ClientStreaming(
@@ -488,7 +495,8 @@ class GenerateStreamClient:
             prompt=list(np.asarray(prompt, np.int32)), steps=steps,
             priority=priority, temperature=temperature, top_k=top_k,
             stop_tokens=[int(t) for t in stop_tokens],
-            device_sampling=device_sampling)
+            device_sampling=device_sampling,
+            return_logprobs=return_logprobs)
         if seed is not None:
             req.seed = seed
         stream.write(req)
@@ -508,7 +516,8 @@ class GenerateStreamClient:
                         raise RuntimeError(
                             f"generation failed: {resp.status.message}")
                     return
-                yield resp.token
+                yield ((resp.token, resp.logprob) if return_logprobs
+                       else resp.token)
         finally:
             if not finished:
                 # consumer abandoned the generator mid-stream: cancel so
