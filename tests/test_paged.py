@@ -68,6 +68,34 @@ def test_paged_pool_accounting(lm):
     assert pool.free_pages == 7
 
 
+def test_prefix_cache_evict_for_alloc_skips_shared(lm):
+    """Pool pressure must not wipe cache entries whose pages are still
+    shared with active requests (refcount > 1): evicting them frees
+    nothing.  Only sole-reference entries fall."""
+    from tpulab.engine.paged import PrefixCache
+    pool = PagedKVPool(n_pages=8, page_size=8, n_layers=1, n_heads=2,
+                       head_dim=16, dtype=jnp.float32)
+    cache = PrefixCache(pool)
+    shared = pool.allocate_page()
+    pool.add_ref(shared)                       # an "active request" ref
+    sole = pool.allocate_page()
+    cache.insert([b"shared-dig", b"sole-dig"], [shared, sole])
+    assert pool.refcount(shared) == 3 and pool.refcount(sole) == 2
+    # "sole" page: only the cache + original alloc hold it; release the
+    # original so the cache truly holds the last meaningful ref path
+    pool.release_pages([sole])
+    assert pool.refcount(sole) == 1
+    # first evict-for-alloc skips the shared (cold-end) entry, drops sole
+    assert cache.evict_for_alloc() is True
+    assert pool.refcount(sole) == 0 and pool.refcount(shared) == 3
+    # nothing evictable remains -> False, shared entry survives
+    assert cache.evict_for_alloc() is False
+    assert len(cache) == 1
+    cache.clear()
+    pool.release_pages([shared, shared])
+    assert pool.free_pages == 7
+
+
 def test_submit_over_capacity_rejected(lm):
     cb = ContinuousBatcher(lm, n_heads=2, n_layers=2, lanes=1, max_len=16,
                            page_size=8, compute_dtype=jnp.float32)

@@ -139,6 +139,11 @@ class PagedKVPool:
                 else:
                     self._refs[p] = n
 
+    def refcount(self, page: int) -> int:
+        """Current reference count (0 for free/unknown pages)."""
+        with self._lock:
+            return self._refs.get(page, 0)
+
 
 @functools.lru_cache(maxsize=None)
 def _kernel_compiles(n_heads: int, head_dim: int, page_size: int,
@@ -523,6 +528,19 @@ class PrefixCache:
         self._pool.release_pages([page])
         return True
 
+    def evict_for_alloc(self) -> bool:
+        """Evict the coldest entry whose page would actually FREE (cache
+        holds the only reference).  Entries shared with active requests
+        (refcount > 1) are skipped: dropping them frees nothing now, so
+        transient pool pressure must not wipe them.  False when no
+        eviction can produce a free page."""
+        for dig, page in self._entries.items():  # OrderedDict: cold first
+            if self._pool.refcount(page) == 1:
+                del self._entries[dig]
+                self._pool.release_pages([page])
+                return True
+        return False
+
     def clear(self) -> None:
         while self.evict_one():
             pass
@@ -568,7 +586,11 @@ class SamplingParams:
         self.top_k = top_k
         self.device = device
         if seed is None:
-            seed = int(np.random.default_rng().integers(0, 2**31 - 1))
+            # full 64-bit draw: device sampling keys on both seed words,
+            # a 31-bit default would zero the hi word for every unseeded
+            # request and shrink the stream space
+            seed = int(np.random.default_rng().integers(
+                0, 2**64, dtype=np.uint64))
         self.seed = int(seed)
         self._rng = np.random.default_rng(seed)
 
@@ -834,7 +856,7 @@ class ContinuousBatcher:
         live requests always outrank cached prefixes."""
         page = self.pool.allocate_page()
         while (page is None and self.prefix_cache is not None
-               and self.prefix_cache.evict_one()):
+               and self.prefix_cache.evict_for_alloc()):
             page = self.pool.allocate_page()
         return page
 
@@ -863,16 +885,24 @@ class ContinuousBatcher:
         # them frees nothing and they already yield every tick.
         while self._queue:
             head = self._queue[0]
+            # a victim only helps if releasing it can actually free a page:
+            # skip lanes whose every page is prefix-cache-shared
+            # (refcount > 1) — preempting them loses decode progress for
+            # zero freed pages
             victims = [(req.priority, -req.admit_seq, lane)
                        for lane, req in enumerate(self._active)
                        if req is not None and req.priority < head.priority
-                       and req.pages]
+                       and any(self.pool.refcount(p) == 1
+                               for p in req.pages)]
             if not victims:
                 return
             _, _, lane = min(victims)
             self._preempt_locked(lane)
             if not self._admit_to_lane_locked(lane):
-                return  # unreachable: the victim's pages just freed
+                # reachable when every victim page was prefix-cache-shared
+                # (refcount > 1): releasing them freed nothing.  Safe to
+                # stop — the head retries next scheduling pass.
+                return
 
     def _preempt_locked(self, lane: int) -> None:
         """Evict the lane's request: free its pages now, re-queue it for an
@@ -1062,9 +1092,12 @@ class ContinuousBatcher:
             req.tokens_out.append(tok)
             lp = None
             if req.want_logprobs:
-                row = np.asarray(last_logits, np.float64)
-                row = row - row.max()
-                lp = float(row[tok] - np.log(np.exp(row).sum()))
+                # same f32 device log_softmax as paged_decode_step: one
+                # request's logprob stream is one precision end to end
+                import jax as _jax
+                import jax.numpy as _j
+                lp = float(np.asarray(_jax.nn.log_softmax(
+                    _j.asarray(last_logits, _j.float32))[tok]))
                 req.logprobs_out.append(lp)
             self._emit(req, tok, 0, lp)
         if self.prefix_cache is not None and not was_resumed:
@@ -1168,7 +1201,10 @@ class ContinuousBatcher:
                 next_tokens[lane] = snapshot[lane].sampling.pick(
                     logits_host[lane])
                 if logprobs_arr is not None:
-                    row = logits_host[lane].astype(np.float64)
+                    # f32 log-sum-exp: the same precision class as the
+                    # device log_softmax used for prefill and for
+                    # device-sampled lanes — one request, one precision
+                    row = logits_host[lane].astype(np.float32)
                     row = row - row.max()
                     logprobs_arr[lane] = float(
                         row[next_tokens[lane]]
