@@ -126,13 +126,15 @@ def test_paged_attention_gqa_matches_expanded_reference():
 
 
 def test_paged_attention_long_context_exceeds_pipeline_depth():
-    """Contexts longer than the kernel's DMA pipeline depth (_NBUF pages)
+    """Contexts with more BLOCKS than the DMA pipeline depth (nbuf slots)
     exercise the in-loop slot refill; a refill racing the slot it is about
-    to read corrupts exactly this regime (pages > _NBUF), which the short
-    tests above never reach."""
-    from tpulab.ops.paged_attention import _NBUF, paged_decode_attention
+    to read corrupts exactly this regime (blocks > nbuf), which the short
+    tests above never reach.  g_pages/nbuf are pinned: the auto geometry
+    would fold a test-sized context into one block."""
+    from tpulab.ops.paged_attention import paged_decode_attention
     rng = jax.random.PRNGKey(3)
-    mp = 2 * _NBUF + 3          # 19 pages deep — well past the pipeline
+    g_pages, nbuf = 2, 4
+    mp = 2 * g_pages * nbuf + 3  # 19 pages = 10 blocks — past the pipeline
     b, h, d, ps = 2, 2, 16, 4
     pages = b * mp + 1
     ks = jax.random.split(rng, 3)
@@ -140,12 +142,34 @@ def test_paged_attention_long_context_exceeds_pipeline_depth():
     k_pool = jax.random.normal(ks[1], (pages, ps, h, d), jnp.float32)
     v_pool = jax.random.normal(ks[2], (pages, ps, h, d), jnp.float32)
     tables = (1 + np.arange(b * mp, dtype=np.int32)).reshape(b, mp)
-    lengths = jnp.asarray([mp * ps - 2, _NBUF * ps + 1], jnp.int32)
+    lengths = jnp.asarray([mp * ps - 2, nbuf * ps + 1], jnp.int32)
     got = paged_decode_attention(q, jnp.stack([k_pool, v_pool], axis=1),
-                                 tables, lengths)
+                                 tables, lengths,
+                                 g_pages=g_pages, nbuf=nbuf)
     want = _paged_reference(q, k_pool, v_pool, tables, lengths)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_paged_attention_partial_tail_block_poison():
+    """A block whose tail pages are dead (beyond the lane's length, never
+    DMA'd — stale VMEM) must not leak them into the output: the score
+    side is masked, and V rides an explicit zeroing before its 0-weight
+    sum (0 * garbage would still be garbage for inf/NaN)."""
+    from tpulab.ops.paged_attention import paged_decode_attention
+    b, h, d, ps, mp = 1, 2, 8, 4, 4
+    q = jnp.ones((b, h, d), jnp.float32)
+    k_pool = jnp.zeros((6, ps, h, d), jnp.float32)
+    v_pool = jnp.zeros((6, ps, h, d), jnp.float32)
+    v_pool = v_pool.at[1].set(5.0)         # live page -> value 5
+    k_pool = k_pool.at[2].set(jnp.inf)     # dead page IN the same block
+    v_pool = v_pool.at[2].set(jnp.nan)
+    tables = jnp.asarray([[1, 2, 3, 0]], jnp.int32)
+    lengths = jnp.asarray([2], jnp.int32)  # 3 tokens: first page only
+    # g_pages=4: one block spans live page 1 and poisoned pages 2/3
+    out = paged_decode_attention(q, jnp.stack([k_pool, v_pool], axis=1),
+                                 tables, lengths, g_pages=4, nbuf=2)
+    np.testing.assert_allclose(np.asarray(out), 5.0, rtol=1e-6)
 
 
 def test_paged_attention_skips_dead_pages():

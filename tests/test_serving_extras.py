@@ -104,6 +104,15 @@ def test_serve_with_batching_enabled():
         futs = [runner.infer(Input3=x) for _ in range(12)]
         outs = [f.result(timeout=60) for f in futs]
         assert all(o["Plus214_Output_0"].shape == (1, 10) for o in outs)
+        # the serving stage profile accumulated per-request costs: total
+        # covers its parts, and the batch window shows up as batch_wait
+        prof = mgr.server._infer_resources.stage_profile()
+        assert prof["n"] == 12
+        for key in ("handler_total_ms", "batch_wait_ms", "pipeline_ms",
+                    "compute_ms", "respond_ms"):
+            assert key in prof, prof
+        assert prof["handler_total_ms"] >= prof["respond_ms"]
+        assert prof["batch_wait_ms"] >= 0.0
     finally:
         remote.close()
         mgr.shutdown()

@@ -149,6 +149,44 @@ def test_continuous_batcher_autoselects_kernel_on_tpu():
         cb.shutdown()
 
 
+def test_kernel_multiblock_refill_race_on_hw():
+    """The in-loop slot refill runs on REAL hardware with MORE BLOCKS than
+    pipeline slots (the auto geometry would fold a test-sized context into
+    one block, so g_pages/nbuf are pinned): an async DMA racing the slot
+    it is about to read corrupts exactly this regime, and interpret-mode
+    DMAs are synchronous so only hardware can catch it."""
+    _require_tpu()
+    import jax
+
+    from tpulab.ops.paged_attention import paged_decode_attention
+    g_pages, nbuf = 2, 3
+    b, h, d, ps, mp = 2, 2, 128, 16, 16   # 8 blocks > 3 slots
+    pages = b * mp + 1
+    rng = np.random.default_rng(1)
+    q = rng.standard_normal((b, h, d)).astype(np.float32)
+    k_pool = rng.standard_normal((pages, ps, h, d)).astype(np.float32)
+    v_pool = rng.standard_normal((pages, ps, h, d)).astype(np.float32)
+    tables = (1 + np.arange(b * mp, dtype=np.int32)).reshape(b, mp)
+    lengths = np.asarray([mp * ps - 2, (nbuf + 1) * g_pages * ps + 1],
+                         np.int32)
+    got = paged_decode_attention(
+        q, jnp.stack([k_pool, v_pool], axis=1), tables, lengths,
+        interpret=False, g_pages=g_pages, nbuf=nbuf)
+    # dense-gather reference, f32 HIGHEST precision on both sides
+    k_ctx = k_pool[tables].reshape(b, mp * ps, h, d)
+    v_ctx = v_pool[tables].reshape(b, mp * ps, h, d)
+    scores = jnp.einsum("bhd,bkhd->bhk", q, k_ctx,
+                        precision=jax.lax.Precision.HIGHEST) / np.sqrt(d)
+    pos = np.arange(mp * ps)
+    mask = pos[None, None, :] <= lengths[:, None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    want = jnp.einsum("bhk,bkhd->bhd", probs, v_ctx,
+                      precision=jax.lax.Precision.HIGHEST)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
 def test_kernel_beats_gather_at_long_context():
     """Perf row (VERDICT #3): tokens/s of the kernel vs gather decode at
     B=8 with a long context (same helper the bench's paged_decode row

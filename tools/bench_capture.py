@@ -21,6 +21,18 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def device_alive(deadline_s: float = 150.0) -> bool:
+    """Cheap subprocess liveness probe (shared with bench.py's canary):
+    skip burning a full bench attempt while the tunnel is down."""
+    try:
+        from bench import _device_canary_subprocess
+        return _device_canary_subprocess(deadline_s)
+    except Exception:
+        return True  # probe machinery broken -> let the attempt decide
 
 
 def attempt(deadline_s: float) -> dict | None:
@@ -48,15 +60,23 @@ def attempt(deadline_s: float) -> dict | None:
 
 
 def is_real_device(rec: dict) -> bool:
-    dev = rec.get("device", "")
-    return ("DEGRADED" not in dev and "TIMEOUT" not in dev
-            and not dev.lower().startswith("cpu")
-            and rec.get("value", 0) > 0)
+    """LIVE on-device line only — shares bench.py's predicate, which also
+    rejects CARRIED-FORWARD lines (a recycled record must never be
+    re-stamped as a fresh capture)."""
+    try:
+        from bench import _is_on_device_record
+        return _is_on_device_record(rec)
+    except Exception:
+        dev = rec.get("device", "")
+        return ("DEGRADED" not in dev and "TIMEOUT" not in dev
+                and "CARRIED-FORWARD" not in dev
+                and not dev.lower().startswith("cpu")
+                and rec.get("value", 0) > 0)
 
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--round", type=int, default=2)
+    ap.add_argument("--round", type=int, default=3)
     ap.add_argument("--attempt-deadline-s", type=float, default=2100.0)
     ap.add_argument("--backoff-s", type=float, default=600.0)
     ap.add_argument("--max-hours", type=float, default=11.0)
@@ -70,6 +90,11 @@ def main() -> int:
     n = 0
     while time.monotonic() < t_end:
         n += 1
+        if not device_alive():
+            print(f"[bench_capture] device down at "
+                  f"{time.strftime('%H:%M:%S')}; waiting", flush=True)
+            time.sleep(args.backoff_s / 2)
+            continue
         print(f"[bench_capture] attempt {n} at {time.strftime('%H:%M:%S')}",
               flush=True)
         rec = attempt(args.attempt_deadline_s)

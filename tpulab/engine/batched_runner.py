@@ -101,7 +101,9 @@ class BatchedInferRunner:
         if n > self.max_batch_size:
             # oversized requests bypass aggregation
             return self._inner.infer(**arrays)
-        item = {"arrays": arrays, "n": n, "future": Future()}
+        import time as _time
+        item = {"arrays": arrays, "n": n, "future": Future(),
+                "t0": _time.perf_counter()}
         groups: List[List[dict]] = []
         with self._lock:
             if self._open_rows + n > self.max_batch_size:
@@ -152,6 +154,12 @@ class BatchedInferRunner:
     def _launch(self, group: List[dict]) -> None:
         if not group:
             return
+        import time as _time
+        t_launch = _time.perf_counter()
+        for it in group:
+            # aggregation wait (enqueue -> launch): the window + any
+            # size-close delay, exported per request for stage profiling
+            it["future"]._tpulab_queue_s = t_launch - it["t0"]
         try:
             combined = {
                 name: np.concatenate([it["arrays"][name] for it in group],

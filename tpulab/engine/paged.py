@@ -1292,7 +1292,7 @@ def benchmark_decode_kernel_vs_gather(n_heads: int = 8, n_layers: int = 4,
                                       ctx: int = 2048, iters: int = 256,
                                       dtype=None) -> Dict[str, Any]:
     """tokens/s of the pallas ragged-paged-attention decode vs the XLA
-    gather fallback at a long-context geometry (the bench perf row and
+    gather fallback at one long-context geometry (the bench perf row and
     the hardware test share this; VERDICT round-1 #3)."""
     import jax.numpy as jnp
 
@@ -1324,6 +1324,24 @@ def benchmark_decode_kernel_vs_gather(n_heads: int = 8, n_layers: int = 4,
         finally:
             pool.close()
     return row
+
+
+def benchmark_decode_kernel_sweep(
+        combos=((8, 2048), (32, 2048), (8, 8192), (8, 16384)),
+        n_heads: int = 8, n_layers: int = 4, d_model: int = 1024,
+        page_size: int = 32, dtype=None) -> List[Dict[str, Any]]:
+    """Kernel-vs-gather across (batch, context) — where the gather's
+    O(B*ctx) HBM materialization explodes and the ragged walk should pull
+    ahead (VERDICT round-2 #3).  Iteration counts scale inversely with
+    per-step work to keep wall time bounded."""
+    rows = []
+    for lanes, ctx in combos:
+        iters = max(16, int(256 * (8 * 2048) / (lanes * ctx)))
+        rows.append(benchmark_decode_kernel_vs_gather(
+            n_heads=n_heads, n_layers=n_layers, d_model=d_model,
+            page_size=page_size, lanes=lanes, ctx=ctx, iters=iters,
+            dtype=dtype))
+    return rows
 
 
 def benchmark_llm_decode(n_heads: int = 16, n_kv_heads: int = 4,

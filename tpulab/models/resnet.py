@@ -182,15 +182,18 @@ def resnet_collect_amax(params, x, depth: int = 50,
 def make_resnet(depth: int = 50, num_classes: int = 1000,
                 image_size: int = 224, max_batch_size: int = 8,
                 compute_dtype=jnp.bfloat16, seed: int = 0,
-                input_dtype=np.float32, batch_buckets=None):
+                input_dtype=np.float32, batch_buckets=None, params=None):
     """Build a servable ResNet Model.
 
     ``input_dtype=np.uint8`` selects the INT8-parity serving path: raw pixel
     bytes in, on-device normalization (4x less ingress bandwidth).
+    ``params`` reuses an existing parameter pytree (several Model views of
+    one weight set, e.g. different bucket plans, without re-init).
     """
     from tpulab.engine.model import IOSpec, Model
 
-    params = init_resnet_params(depth, num_classes, seed)
+    if params is None:
+        params = init_resnet_params(depth, num_classes, seed)
     apply_fn = partial(resnet_apply, depth=depth, compute_dtype=compute_dtype)
     return Model(
         name=f"resnet{depth}",

@@ -65,6 +65,27 @@ class InferResources(Resources):
         self._batched: Dict[str, object] = {}
         self._generate_workers = None  # dedicated pool, built on first use
         self._lock = __import__("threading").Lock()
+        # per-stage serving profile (sums + count): where a request's
+        # milliseconds go between proto-in and proto-out — the measured
+        # answer to "what does the RPC layer cost" (VERDICT r2 #4)
+        self._stage_sums: Dict[str, float] = {}
+        self._stage_n = 0
+
+    def observe_stages(self, **seconds: float) -> None:
+        with self._lock:
+            self._stage_n += 1
+            for k, v in seconds.items():
+                self._stage_sums[k] = self._stage_sums.get(k, 0.0) + v
+
+    def stage_profile(self) -> Dict[str, float]:
+        """Mean per-request stage costs in ms (plus the sample count)."""
+        with self._lock:
+            if not self._stage_n:
+                return {}
+            out = {f"{k}_ms": round(1e3 * v / self._stage_n, 3)
+                   for k, v in self._stage_sums.items()}
+            out["n"] = self._stage_n
+            return out
 
     def generate_workers(self):
         """Generation gets its own workers: long decodes + session-pool
@@ -172,21 +193,33 @@ class InferContext(Context):
         try:
             import time as _time
             runner = res.runner(request.model_name)
-            t0 = _time.monotonic()
+            t0 = _time.perf_counter()
             fut = runner.infer(**arrays)
             outputs = fut.result()
+            t1 = _time.perf_counter()
             # prefer the per-request compute-site measurement (set on the
             # future before resolution — race-free); the wait-time fallback
             # includes queueing/window
             compute_s = (getattr(fut, "_tpulab_compute_s", None)
-                         or (_time.monotonic() - t0))
+                         or (t1 - t0))
             wanted = set(request.requested_outputs) or set(outputs)
             for name, arr in outputs.items():
                 if name in wanted:
                     resp.outputs.append(tensor_to_proto(name, arr))
+            t2 = _time.perf_counter()
             resp.status.code = pb.SUCCESS
             if res.metrics is not None:
                 res.metrics.observe_request(self.walltime(), compute_s)
+            # stage accounting: window+queue from the batched runner when
+            # present; pipeline = everything between enqueue-return and
+            # result minus the aggregation wait
+            queue_s = getattr(fut, "_tpulab_queue_s", 0.0)
+            res.observe_stages(
+                handler_total=self.walltime(),
+                batch_wait=queue_s,
+                pipeline=(t1 - t0) - queue_s,
+                compute=compute_s or 0.0,
+                respond=t2 - t1)
         except Exception as e:  # noqa: BLE001
             log.exception("inference failed")
             resp.status.code = pb.INTERNAL
