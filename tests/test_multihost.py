@@ -56,3 +56,87 @@ def test_two_process_distributed_barrier():
     # the 5-row global batch splits 3/2 across the two processes
     assert "slice=[0,3)" in outs[0][1]
     assert "slice=[3,5)" in outs[1][1]
+
+
+_SERVE_WORKER = """
+import sys
+from tpulab.tpu.platform import force_cpu
+force_cpu(1)
+from tpulab.parallel import multihost
+
+pid, coord_port, serve_port = (int(sys.argv[1]), sys.argv[2],
+                               int(sys.argv[3]))
+multihost.initialize(f"127.0.0.1:{coord_port}", num_processes=2,
+                     process_id=pid)
+import jax
+assert jax.process_count() == 2
+
+from tpulab.engine.inference_manager import InferenceManager
+from tpulab.models.mnist import make_mnist
+
+mgr = InferenceManager(max_executions=2, max_buffers=8)
+mgr.register_model("mnist", make_mnist(max_batch_size=8))
+mgr.update_resources()
+mgr.serve(port=serve_port, batching=True, batch_window_s=0.005)
+print(f"READY pid={pid} port={mgr.server.bound_port}", flush=True)
+sys.stdin.readline()      # parent closes stdin -> shut down
+mgr.shutdown()
+print(f"DONE pid={pid}", flush=True)
+"""
+
+
+def test_two_process_distributed_serving_dp_dispatch():
+    """VERDICT r2 #7: a 2-process jax.distributed deployment that actually
+    SERVES — each process runs its own gRPC inference service; the client
+    routes least-loaded across both (ReplicaSet), asserting per-replica
+    health and that BOTH replicas carried traffic."""
+    import numpy as np
+
+    from tests.conftest import free_port
+    coord = free_port()
+    env = {**os.environ, "PYTHONPATH": REPO, "HOME": "/tmp",
+           "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _SERVE_WORKER, str(i), str(coord), "0"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env) for i in range(2)]
+    rs = None
+    try:
+        ports = []
+        for p in procs:
+            line = p.stdout.readline()
+            assert line.startswith("READY"), (line, p.stderr.read()[-2000:])
+            ports.append(int(line.strip().rsplit("port=", 1)[1]))
+        from tpulab.rpc.replica import ReplicaSet
+        rs = ReplicaSet([f"127.0.0.1:{pt}" for pt in ports], "mnist")
+        health = rs.health()
+        assert all(h["live"] and h["ready"] for h in health.values()), health
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        import time
+        n, depth, futs = 40, 8, []
+        t0 = time.perf_counter()
+        for _ in range(n):
+            while len(futs) >= depth:
+                futs.pop(0).result(timeout=120)
+            futs.append(rs.infer(Input3=x))
+        outs = [f.result(timeout=120) for f in futs]
+        wall = time.perf_counter() - t0
+        assert all(o["Plus214_Output_0"].shape == (1, 10) for o in outs[-5:])
+        assert sum(rs.served) == n
+        assert all(s > 0 for s in rs.served), rs.served  # both carried load
+        print(f"[multihost-serve] {n / wall:.1f} inf/s aggregate, "
+              f"split={rs.served}")
+    finally:
+        if rs is not None:
+            rs.close()
+        for p in procs:
+            try:
+                p.stdin.close()
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -376,3 +376,32 @@ def test_executor_saturation_sheds_load_and_recovers(kind):
                 raise AssertionError("server did not recover after storm")
     finally:
         server.shutdown()
+
+
+def test_executor_owns_thread_placement_and_context_pool():
+    """Round-3 executor parity (reference executor.h:39-113): worker
+    threads pin to the executor's cpu plan, and unary contexts recycle
+    through the pre-armed free-list instead of per-call instantiation."""
+    import os
+    cpu0 = sorted(os.sched_getaffinity(0))[0]
+    executor = Executor(n_threads=2, contexts_per_thread=4, cpus=[cpu0])
+    server, res = build_server(executor)
+    try:
+        with _client(server) as cx:
+            unary = ClientUnary(cx, f"/{ECHO}/Unary")
+            for i in range(8):
+                assert unary.call(b"x", timeout=10) == b"pong:x"
+        # workers pinned (cpus < n_threads -> each shares the whole set)
+        assert executor.pinned, "no worker thread reported a pin"
+        assert all(p == (cpu0,) for p in executor.pinned), executor.pinned
+        rpc = server._services[0].rpcs["Unary"]
+        assert rpc.ctx_pool_cap == executor.max_concurrency
+        assert len(rpc.ctx_pool) >= 1  # contexts parked between calls
+        # sequential calls reuse the SAME context object
+        parked = {id(c) for c in rpc.ctx_pool}
+        with _client(server) as cx:
+            unary = ClientUnary(cx, f"/{ECHO}/Unary")
+            assert unary.call(b"y", timeout=10) == b"pong:y"
+        assert {id(c) for c in rpc.ctx_pool} <= parked
+    finally:
+        server.shutdown()

@@ -570,6 +570,16 @@ class RemoteInferenceManager:
         self._infer = ClientUnary(
             self._executor, f"/{SERVICE_NAME}/Infer",
             pb.InferRequest.SerializeToString, pb.InferResponse.FromString)
+        self._health = ClientUnary(
+            self._executor, f"/{SERVICE_NAME}/Health",
+            pb.HealthRequest.SerializeToString, pb.HealthResponse.FromString)
+
+    def health(self, timeout: float = 10.0) -> pb.HealthResponse:
+        """Liveness/readiness probe (reference TRTIS Health)."""
+        return self._health.start(pb.HealthRequest()).result(timeout=timeout)
+
+    def health_async(self):
+        return self._health.start(pb.HealthRequest())
 
     def get_models(self) -> Dict[str, pb.ModelStatus]:
         resp = self._status.call(pb.StatusRequest())

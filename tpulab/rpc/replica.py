@@ -1,0 +1,128 @@
+"""Cross-process replica routing: a client-side replica set over remote
+inference endpoints.
+
+The reference scales out with N single-GPU services behind an L7 balancer
+(examples/98_MultiProcessSingleStream launch topology + examples/99's
+envoy); this is the in-framework form of the same axis (SURVEY §2.8
+axes 5-6): a :class:`ReplicaSet` holds one remote manager per endpoint,
+health-checks them, routes each request to the least-loaded live replica
+and fails a request over to the next replica when one dies mid-flight
+(inference is idempotent — a retry cannot corrupt state).
+
+Complements, not replaces, a real L7 balancer: envoy owns cross-client
+balancing in deployment (examples/99_loadbalancer); ReplicaSet gives one
+process the same behavior with zero infrastructure — and is what the
+multihost serving test drives across two jax.distributed processes.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+from tpulab.rpc.infer_service import RemoteInferenceManager
+
+
+class ReplicaSet:
+    """Least-loaded router with failover over remote replicas."""
+
+    def __init__(self, addresses: Sequence[str], model_name: str,
+                 channels: int = 1, max_failover: Optional[int] = None):
+        if not addresses:
+            raise ValueError("need at least one replica address")
+        self.addresses = list(addresses)
+        self.model_name = model_name
+        self._managers = [RemoteInferenceManager(a, channels=channels)
+                          for a in self.addresses]
+        self._runners = [m.infer_runner(model_name) for m in self._managers]
+        self._inflight = [0] * len(self._runners)
+        #: requests completed per replica (observability / test assertions)
+        self.served = [0] * len(self._runners)
+        self._lock = threading.Lock()
+        self._max_failover = (len(self._runners) if max_failover is None
+                              else max_failover)
+
+    # -- health -------------------------------------------------------------
+    def health(self, timeout: float = 10.0) -> Dict[str, dict]:
+        """Per-replica liveness/readiness (exceptions become dead
+        entries rather than raising — the set is expected to outlive
+        individual replicas)."""
+        out: Dict[str, dict] = {}
+        futs = [(a, m.health_async()) for a, m in zip(self.addresses,
+                                                      self._managers)]
+        for addr, fut in futs:
+            try:
+                resp = fut.result(timeout=timeout)
+                out[addr] = {"live": resp.live, "ready": resp.ready}
+            except Exception as e:  # noqa: BLE001 - dead replica is data
+                out[addr] = {"live": False, "ready": False,
+                             "error": f"{type(e).__name__}: {e}"}
+        return out
+
+    # -- dispatch -----------------------------------------------------------
+    def _pick(self, exclude: frozenset) -> Optional[int]:
+        with self._lock:
+            candidates = [(n, i) for i, n in enumerate(self._inflight)
+                          if i not in exclude]
+            if not candidates:
+                return None
+            _, idx = min(candidates)
+            self._inflight[idx] += 1
+            return idx
+
+    def infer(self, **arrays) -> Future:
+        """Future of the outputs dict; rides the least-loaded replica and
+        fails over (re-submits) when a replica errors mid-flight."""
+        outer: Future = Future()
+        self._submit(outer, arrays, attempts_left=self._max_failover,
+                     exclude=frozenset())
+        return outer
+
+    def _submit(self, outer: Future, arrays: dict, attempts_left: int,
+                exclude: frozenset) -> None:
+        idx = self._pick(exclude)
+        if idx is None:  # every replica already failed this request
+            idx = self._pick(frozenset())
+        if idx is None:  # unreachable: >=1 replica by construction
+            outer.set_exception(RuntimeError("no replicas"))
+            return
+
+        def on_done(fut: Future) -> None:
+            with self._lock:
+                self._inflight[idx] -= 1
+            exc = fut.exception()
+            if exc is None:
+                with self._lock:
+                    self.served[idx] += 1
+                if not outer.done():
+                    outer.set_result(fut.result())
+                return
+            if attempts_left > 1 and not outer.done():
+                self._submit(outer, arrays, attempts_left - 1,
+                             exclude | {idx})
+            elif not outer.done():
+                outer.set_exception(exc)
+
+        try:
+            self._runners[idx].infer(**arrays).add_done_callback(on_done)
+        except Exception as e:  # submission itself failed (dead channel)
+            with self._lock:
+                self._inflight[idx] -= 1
+            if attempts_left > 1:
+                self._submit(outer, arrays, attempts_left - 1,
+                             exclude | {idx})
+            else:
+                outer.set_exception(e)
+
+    @property
+    def inflight(self) -> List[int]:
+        with self._lock:
+            return list(self._inflight)
+
+    def close(self) -> None:
+        for m in self._managers:
+            try:
+                m.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass

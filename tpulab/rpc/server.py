@@ -46,6 +46,25 @@ class _RPCDef:
         self.resp_ser = resp_ser
         self.resources = resources
         self.dispatcher = None  # built for BatchingContext at server start
+        # pre-armed context free-list (reference pre-allocated contexts,
+        # executor.cc:48-67): unary contexts recycle through here instead
+        # of re-instantiating per call.  Streaming/batching contexts carry
+        # per-stream state and are never pooled.
+        self.ctx_pool: List[Any] = []
+        self.ctx_pool_lock = threading.Lock()
+        self.ctx_pool_cap = 0  # set at server start from the executor
+
+    def acquire_context(self):
+        with self.ctx_pool_lock:
+            if self.ctx_pool:
+                return self.ctx_pool.pop()
+        return self.context_cls(self.resources)
+
+    def release_context(self, ctx) -> None:
+        ctx.grpc_context = None
+        with self.ctx_pool_lock:
+            if len(self.ctx_pool) < self.ctx_pool_cap:
+                self.ctx_pool.append(ctx)
 
 
 class AsyncService:
@@ -167,18 +186,17 @@ class Server:
     # -- sync (thread Executor) ----------------------------------------------
     def _start_sync(self) -> None:
         ex = self.executor
-        # blocking handlers need a worker each while in flight — size the
-        # pool to the pre-armed-context bound (reference contexts_per_thread),
-        # capped to keep thread count sane
-        pool = _futures.ThreadPoolExecutor(
-            max_workers=max(ex.n_threads, min(ex.max_concurrency, 128)),
-            thread_name_prefix="rpc")
+        # the executor OWNS the worker pool: sizing to the pre-armed-context
+        # bound (reference contexts_per_thread) and pinning each worker to
+        # the executor's cpu plan (reference CQ-thread affinity)
+        pool = ex.build_worker_pool()
         self._worker_pool = pool
         self._server = grpc.server(
             pool, maximum_concurrent_rpcs=ex.max_concurrency)
         for service in self._services:
             handlers = {}
             for rpc in service.rpcs.values():
+                rpc.ctx_pool_cap = min(ex.max_concurrency, 256)
                 handlers[rpc.name] = self._make_sync_handler(rpc)
             self._server.add_generic_rpc_handlers(
                 (grpc.method_handlers_generic_handler(service.name, handlers),))
@@ -244,13 +262,14 @@ class Server:
                 batch_behavior, rpc.req_des, rpc.resp_ser)
 
         def unary_behavior(request, grpc_ctx):
-            ctx = rpc.context_cls(rpc.resources)
+            ctx = rpc.acquire_context()   # pre-armed context free-list
             ctx.grpc_context = grpc_ctx
             ctx.on_lifecycle_start()
             try:
                 return ctx.execute_rpc(request)
             finally:
                 ctx.on_lifecycle_reset()
+                rpc.release_context(ctx)
         return grpc.unary_unary_rpc_method_handler(
             unary_behavior, rpc.req_des, rpc.resp_ser)
 
@@ -260,6 +279,8 @@ class Server:
         startup_error: List[BaseException] = []
 
         def loop_main():
+            if hasattr(self.executor, "pin_loop_thread"):
+                self.executor.pin_loop_thread()  # reference thread affinity
             loop = asyncio.new_event_loop()
             asyncio.set_event_loop(loop)
             self._loop = loop
@@ -270,6 +291,8 @@ class Server:
                 for service in self._services:
                     handlers = {}
                     for rpc in service.rpcs.values():
+                        rpc.ctx_pool_cap = min(
+                            self.executor.max_concurrency, 256)
                         handlers[rpc.name] = self._make_aio_handler(rpc)
                     server.add_generic_rpc_handlers(
                         (grpc.method_handlers_generic_handler(
@@ -356,12 +379,13 @@ class Server:
                 batch_behavior, rpc.req_des, rpc.resp_ser)
 
         async def unary_behavior(request, grpc_ctx):
-            ctx = rpc.context_cls(rpc.resources)
+            ctx = rpc.acquire_context()   # pre-armed context free-list
             ctx.grpc_context = grpc_ctx
             ctx.on_lifecycle_start()
             try:
                 return await maybe_await(ctx.execute_rpc(request))
             finally:
                 ctx.on_lifecycle_reset()
+                rpc.release_context(ctx)
         return grpc.unary_unary_rpc_method_handler(
             unary_behavior, rpc.req_des, rpc.resp_ser)
