@@ -13,15 +13,20 @@ backend cannot poison this process), retries spread over minutes; every
 phase updates a shared partial-results record; a global watchdog prints the
 partial JSON line and exits if the run exceeds its deadline.  Every
 successful on-device run persists its full record to
-``docs/BENCH_LAST_GOOD.json``; if the live run ever has to fall back to CPU,
-the emitted line CARRIES FORWARD the round's best on-device record —
-clearly labeled, with the live degraded result preserved alongside — so a
-late-round tunnel wedge can no longer erase the round's TPU evidence.
+``docs/BENCH_LAST_GOOD.json``.
+
+Provenance (round-4, advisor-medium fix): the top-level ``value`` /
+``vs_baseline`` are ALWAYS the live run's result — a consumer parsing only
+those keys can never mistake a historical record for this run.  When the
+live run degrades to CPU, the most RECENT on-device record (latest-good,
+not best-ever) is attached under the separate ``last_good`` key with its
+capture time, round, source and age spelled out.
 Env knobs:
   TPULAB_BENCH_DEGRADED=1      force the flagged CPU fallback
   TPULAB_BENCH_DEADLINE_S      global deadline (default 1500)
   TPULAB_BENCH_CANARY_TRIES    canary attempts (default 4, 150 s each)
-  TPULAB_BENCH_NO_CARRY=1      disable the last-good carry-forward
+  TPULAB_BENCH_NO_CARRY=1      disable the last-good attachment
+  TPULAB_BENCH_ROUND           round number stamped into saved records
 """
 
 from __future__ import annotations
@@ -75,6 +80,9 @@ def _save_last_good(line: dict) -> None:
         rec = dict(line)
         rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime())
+        rnd = os.environ.get("TPULAB_BENCH_ROUND")
+        if rnd:
+            rec["round"] = int(rnd)
         store["latest"] = rec
         if (not isinstance(store.get("best"), dict)
                 or float(store["best"].get("value", 0))
@@ -89,15 +97,47 @@ def _save_last_good(line: dict) -> None:
         print(f"# last-good save failed: {e!r}", file=sys.stderr)
 
 
+def _source_round(rec: dict) -> int:
+    """Round number of a record: explicit stamp, else parsed from its
+    source filename (``BENCH_MID_r02.json`` -> 2), else 0."""
+    if isinstance(rec.get("round"), int):
+        return rec["round"]
+    import re
+    m = re.search(r"_r(\d+)", str(rec.get("source_file", "")))
+    return int(m.group(1)) if m else 0
+
+
+def _record_age_str(rec: dict, now: float | None = None) -> str:
+    """Human age of a capture ('3.2 d old'), or 'unknown age'."""
+    ts = rec.get("captured_at")
+    if not ts:
+        return "unknown age"
+    try:
+        import calendar
+        t = calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+        days = ((now if now is not None else time.time()) - t) / 86400.0
+        return f"{days:.1f} d old"
+    except Exception:
+        return "unknown age"
+
+
 def _load_last_good() -> dict | None:
-    """Best available on-device record from this repo's capture artifacts."""
+    """Most RECENT on-device record from this repo's capture artifacts.
+
+    Selection policy (VERDICT r3 weak #6): latest-good, NOT best-ever — a
+    historical best would age well past reality if live captures keep
+    failing.  Order: capture timestamp desc; untimestamped records rank
+    below any timestamped one, ordered by source round, then by value."""
     cands = []
     try:
         if os.path.exists(LAST_GOOD_PATH):
             with open(LAST_GOOD_PATH) as f:
                 store = json.load(f)
-            cands += [r for r in (store.get("best"), store.get("latest"))
-                      if isinstance(r, dict)]
+            for k in ("latest", "best"):
+                if isinstance(store.get(k), dict):
+                    r = dict(store[k])
+                    r.setdefault("source_file", f"BENCH_LAST_GOOD:{k}")
+                    cands.append(r)
     except Exception:
         pass
     for p in sorted(glob.glob(os.path.join(REPO, "docs", "BENCH_*_r*.json"))):
@@ -112,7 +152,9 @@ def _load_last_good() -> dict | None:
     cands = [r for r in cands if _is_on_device_record(r)]
     if not cands:
         return None
-    return max(cands, key=lambda r: float(r.get("value", 0) or 0))
+    return max(cands, key=lambda r: (str(r.get("captured_at") or ""),
+                                     _source_round(r),
+                                     float(r.get("value", 0) or 0)))
 
 
 def _emit_line(timeout_phase: str | None = None) -> None:
@@ -141,34 +183,47 @@ def _emit_line(timeout_phase: str | None = None) -> None:
         _save_last_good(line)
     elif (os.environ.get("TPULAB_BENCH_NO_CARRY") != "1"
           and os.environ.get("TPULAB_BENCH_CPU_FULL") != "1"):
-        # CPU_FULL is a deliberate CI smoke of the CPU path — its line must
-        # stay the live CPU result, never a recycled TPU record
-        # live run never reached the chip: carry forward the round's best
-        # persisted on-device record, clearly labeled, and keep the live
-        # (degraded/partial) result alongside — zero information loss,
-        # no silent substitution
+        # CPU_FULL is a deliberate CI smoke of the CPU path.  Advisor-medium
+        # (round 3): the live (degraded) result STAYS the headline
+        # 'value'/'vs_baseline' — no historical number is ever swapped into
+        # the keys a naive consumer parses.  The most recent on-device
+        # record rides along under 'last_good', age and round spelled out.
         lg = _load_last_good()
         if lg is not None:
-            live = {"value": line["value"], "device": line["device"],
-                    "details": line["details"]}
-            line = {
-                "metric": line["metric"],
+            line["degraded"] = True
+            line["last_good"] = {
                 "value": lg["value"],
                 "unit": line["unit"],
                 "vs_baseline": round(
                     float(lg["value"]) / BASELINE_INF_PER_SEC, 4),
-                "device": (f"{lg.get('device', 'TPU')} (CARRIED-FORWARD "
-                           f"from on-device capture at "
-                           f"{lg.get('captured_at', 'unknown time')}; "
-                           f"live run: {live['device']})"),
-                "carried_forward": True,
-                "details": dict(lg.get("details", {}),
-                                live_run=live,
-                                last_good_captured_at=lg.get("captured_at"),
-                                last_good_source=lg.get("source_file",
-                                                        "BENCH_LAST_GOOD")),
+                "device": lg.get("device", "TPU"),
+                "captured_at": lg.get("captured_at"),
+                "round": _source_round(lg) or None,
+                "age": _record_age_str(lg),
+                "source": lg.get("source_file", "BENCH_LAST_GOOD"),
+                "details": lg.get("details", {}),
             }
+            line["device"] += (
+                f" [headline is the LIVE degraded result; last on-device "
+                f"capture: {lg['value']} {line['unit']} "
+                f"(round {_source_round(lg) or '?'}, "
+                f"{_record_age_str(lg)}) under 'last_good']")
     print(json.dumps(line), flush=True)
+
+
+def _pipelined_rate(submit, n: int, depth: int,
+                    timeout: float = 300.0) -> float:
+    """Requests/second of a depth-bounded pipelined siege over
+    ``submit() -> Future`` (the shared loop under every serving row)."""
+    futs: list = []
+    t0 = time.perf_counter()
+    for _ in range(n):
+        while len(futs) >= depth:
+            futs.pop(0).result(timeout=timeout)
+        futs.append(submit())
+    for f in futs:
+        f.result(timeout=timeout)
+    return n / (time.perf_counter() - t0)
 
 
 def _watchdog(deadline_s: float) -> None:
@@ -314,8 +369,33 @@ def main() -> None:
     sweep = ((8, 2.0),) if degraded else ((8, 5.0), (128, 10.0))
     model = make_resnet(depth=50, max_batch_size=buckets[-1],
                         input_dtype=np.uint8, batch_buckets=buckets)
+    # calibrated full-INT8 (W8A8) servable twin (VERDICT r3 #9: the
+    # reference headline IS int8 END-TO-END, not compute-only) — same
+    # weights, int8 kernels + per-unit activation scales; served next to
+    # the bf16 model through the identical pipeline and gRPC path
+    qparams = None
+    if not degraded:
+        _phase("calibrate_int8")
+        try:
+            from tpulab.models.quantization import (
+                calibrate_resnet, quantize_resnet_params_w8a8)
+            cal = np.random.default_rng(0).standard_normal(
+                (4, 224, 224, 3)).astype(np.float32)
+            qparams = quantize_resnet_params_w8a8(
+                model.params, calibrate_resnet(model.params, [cal]))
+        except Exception as e:
+            print(f"# int8 calibration skipped: {e!r}", file=sys.stderr)
     mgr = InferenceManager(max_executions=8, max_buffers=32)
     mgr.register_model("rn50", model)
+    if qparams is not None:
+        try:
+            # coarser bucket plan than bf16: 3 extra compiles, not 8
+            mgr.register_model("rn50i8", make_resnet(
+                depth=50, max_batch_size=64, input_dtype=np.uint8,
+                batch_buckets=[1, 16, 64], params=qparams))
+        except Exception as e:  # int8 must never sink the bf16 number
+            qparams = None
+            print(f"# int8 registration skipped: {e!r}", file=sys.stderr)
     mgr.update_resources()
     # the b=1 headline rides its OWN manager: staging bundles are sized to
     # the largest registered bucket, so a deep (256) pipeline is only
@@ -327,6 +407,14 @@ def main() -> None:
     mgr_b1 = InferenceManager(max_executions=16,
                               max_buffers=16 if degraded else 288)
     mgr_b1.register_model("rn50", model_b1)
+    if qparams is not None:
+        try:
+            mgr_b1.register_model("rn50i8", make_resnet(
+                depth=50, max_batch_size=1, input_dtype=np.uint8,
+                batch_buckets=[1], params=qparams))
+        except Exception as e:
+            qparams = None
+            print(f"# int8 b1 registration skipped: {e!r}", file=sys.stderr)
     # tiny identity model: host-pipeline cost probe (see pipeline_floor)
     from tpulab.engine.model import IOSpec, Model
     mgr_b1.register_model("null", Model(
@@ -357,6 +445,18 @@ def main() -> None:
         r = bench_b1.run("rn50", batch_size=1, seconds=5.0, warmup=2,
                          depth=depth)
         _record(b1_inf_s=round(r["inferences_per_second"], 1))
+        if qparams is not None:
+            # the int8 model through the IDENTICAL full pipeline at the
+            # bf16-best depth — the dtype-for-dtype end-to-end comparison
+            _phase("pipeline_b1_int8")
+            try:
+                ri = bench_b1.run("rn50i8", batch_size=1, seconds=5.0,
+                                  warmup=2, depth=depth)
+                _record(b1_int8_inf_s=round(
+                    ri["inferences_per_second"], 1))
+            except Exception as e:
+                print(f"# int8 pipeline row skipped: {e!r}",
+                      file=sys.stderr)
     for b, secs in sweep:
         _phase(f"pipeline_b{b}")
         r = bench.run("rn50", batch_size=b, seconds=secs, warmup=2)
@@ -426,17 +526,10 @@ def main() -> None:
     # full-INT8 (W8A8) compute ceiling: int8 x int8 -> int32 convs on the
     # MXU — the dtype-for-dtype comparison against the reference's INT8
     # headline (examples/ONNX/resnet50/int8.py calibrated engines)
-    if not degraded:
+    if not degraded and qparams is not None:
         _phase("compute_only_w8a8")
         try:
-            from tpulab.models.quantization import (
-                calibrate_resnet, quantize_resnet_params_w8a8)
-            cal = np.random.default_rng(0).standard_normal(
-                (4, 224, 224, 3)).astype(np.float32)
-            ranges = calibrate_resnet(model.params, [cal])
-            qp = jax.device_put(
-                quantize_resnet_params_w8a8(model.params, ranges),
-                mgr.device)
+            qp = jax.device_put(qparams, mgr.device)
             np.asarray(_chain(qp, dev_img))  # compile + warm
             t0 = time.perf_counter()
             np.asarray(_chain(qp, dev_img))
@@ -525,16 +618,50 @@ def main() -> None:
         img = np.random.default_rng(0).integers(
             0, 255, (1, 224, 224, 3)).astype(np.uint8)
         r_runner.infer(input=img).result(timeout=300)  # warm
-        n_req, depth, futs = (50, 16, []) if degraded else (400, 64, [])
-        t0 = time.perf_counter()
-        for _ in range(n_req):
-            while len(futs) >= depth:
-                futs.pop(0).result(timeout=300)
-            futs.append(r_runner.infer(input=img))
-        for f in futs:
-            f.result(timeout=300)
-        _record(grpc_batched_b1_inf_s=round(
-            n_req / (time.perf_counter() - t0), 1))
+        n_req, depth = (50, 16) if degraded else (400, 64)
+        _record(grpc_batched_b1_inf_s=round(_pipelined_rate(
+            lambda: r_runner.infer(input=img), n_req, depth), 1))
+        if qparams is not None and not degraded:
+            _phase("grpc_serving_int8")
+            ri_runner = remote.infer_runner("rn50i8")
+            ri_runner.infer(input=img).result(timeout=300)  # warm
+            _record(grpc_int8_b1_inf_s=round(_pipelined_rate(
+                lambda: ri_runner.infer(input=img), n_req, depth), 1))
+        if not degraded:
+            # streaming ingestion: one bidi stream, responses correlated
+            # by id — drops the per-call unary machinery (the
+            # grpc_health_rpc_us floor) from every request
+            _phase("grpc_stream")
+            from tpulab.rpc.infer_service import StreamInferClient
+            sc = StreamInferClient(remote, "rn50")
+            sc.submit(input=img).result(timeout=300)  # warm
+            _record(grpc_stream_b1_inf_s=round(_pipelined_rate(
+                lambda: sc.submit(input=img), n_req, depth), 1))
+            sc.close()
+            # aggregation-window sweep (VERDICT r3 #5: tune the toll with
+            # the profiler's evidence): smaller windows cut queue wait,
+            # larger ones build bigger groups — measure, don't guess
+            _phase("grpc_window_sweep")
+            wsweep = {}
+            for w in (0.0005, 0.001, 0.004):
+                srv2 = rem2 = None
+                try:
+                    srv2 = build_infer_service(
+                        mgr, "0.0.0.0:0", batching=True, batch_window_s=w)
+                    srv2.async_start()
+                    srv2.wait_until_running()
+                    rem2 = RemoteInferenceManager(
+                        f"localhost:{srv2.bound_port}", channels=8)
+                    rr2 = rem2.infer_runner("rn50")
+                    rr2.infer(input=img).result(timeout=300)  # warm
+                    wsweep[f"{w * 1e3:g}ms"] = round(_pipelined_rate(
+                        lambda: rr2.infer(input=img), 200, depth), 1)
+                finally:
+                    if rem2 is not None:
+                        rem2.close()
+                    if srv2 is not None:
+                        srv2.shutdown()
+            _record(grpc_window_sweep=wsweep)
         # measured per-stage breakdown of the RPC path (where the
         # milliseconds go: aggregation window, pipeline, compute, respond)
         prof = server._infer_resources.stage_profile()
@@ -545,16 +672,9 @@ def main() -> None:
         # device, pure RPC machinery (VERDICT r2 #5: measure, don't guess)
         _phase("grpc_null_rpc")
         remote.health()  # warm the channel/stub
-        n_h, futs = (100 if degraded else 2000), []
-        t0 = time.perf_counter()
-        for _ in range(n_h):
-            while len(futs) >= 64:
-                futs.pop(0).result(timeout=60)
-            futs.append(remote.health_async())
-        for f in futs:
-            f.result(timeout=60)
-        _record(grpc_health_rpc_us=round(
-            1e6 * (time.perf_counter() - t0) / n_h, 1))
+        n_h = 100 if degraded else 2000
+        rate = _pipelined_rate(remote.health_async, n_h, 64, timeout=60)
+        _record(grpc_health_rpc_us=round(1e6 / rate, 1))
     except Exception as e:
         print(f"# serving metric skipped: {e!r}", file=sys.stderr)
     finally:  # never leak the server into the rest of the bench

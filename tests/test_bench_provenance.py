@@ -1,0 +1,111 @@
+"""Carry-forward provenance policy (VERDICT r3 weak #6 + advisor-medium):
+the emitted headline must always be the LIVE result, the attached
+last-good record must be the most RECENT on-device capture (not the
+historical best), and its age/round must be spelled out."""
+
+import io
+import json
+import os
+
+import pytest
+
+import bench
+
+
+def _write(path, rec):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(rec, f)
+
+
+@pytest.fixture
+def repo(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                        str(tmp_path / "docs" / "BENCH_LAST_GOOD.json"))
+    return tmp_path
+
+
+def test_latest_good_beats_best_ever(repo):
+    _write(str(repo / "docs" / "BENCH_EARLY_r02.json"),
+           {"value": 500.0, "device": "TPU v4",
+            "captured_at": "2026-05-01T00:00:00Z"})
+    _write(str(repo / "docs" / "BENCH_EARLY_r04.json"),
+           {"value": 120.0, "device": "TPU v4",
+            "captured_at": "2026-07-20T00:00:00Z"})
+    lg = bench._load_last_good()
+    assert lg["value"] == 120.0  # newer wins even though older is bigger
+
+
+def test_untimestamped_ranks_below_any_timestamped(repo):
+    _write(str(repo / "docs" / "BENCH_MID_r02.json"),
+           {"value": 900.0, "device": "TPU v4"})  # no captured_at
+    _write(str(repo / "docs" / "BENCH_EARLY_r03.json"),
+           {"value": 100.0, "device": "TPU v4",
+            "captured_at": "2026-06-01T00:00:00Z"})
+    assert bench._load_last_good()["value"] == 100.0
+
+
+def test_untimestamped_tie_broken_by_source_round(repo):
+    _write(str(repo / "docs" / "BENCH_MID_r02.json"),
+           {"value": 900.0, "device": "TPU v4"})
+    _write(str(repo / "docs" / "BENCH_MID_r03.json"),
+           {"value": 400.0, "device": "TPU v4"})
+    lg = bench._load_last_good()
+    assert lg["value"] == 400.0
+    assert bench._source_round(lg) == 3
+
+
+def test_non_device_records_rejected(repo):
+    for name, rec in [
+        ("BENCH_EARLY_r01.json", {"value": 50.0, "device": "cpu"}),
+        ("BENCH_MID_r01.json", {"value": 60.0,
+                                "device": "TPU (DEGRADED: fallback)"}),
+        ("BENCH_LATE_r01.json", {"value": 70.0,
+                                 "device": "TPU v4 (CARRIED-FORWARD ...)"}),
+        ("BENCH_ZERO_r01.json", {"value": 0.0, "device": "TPU v4"}),
+    ]:
+        _write(str(repo / "docs" / name), rec)
+    assert bench._load_last_good() is None
+
+
+def test_emit_keeps_live_headline_and_attaches_last_good(repo, monkeypatch,
+                                                         capsys):
+    """Advisor-medium: a degraded run's 'value'/'vs_baseline' stay the live
+    numbers; the on-device record rides under 'last_good' with age+round."""
+    _write(str(repo / "docs" / "BENCH_EARLY_r03.json"),
+           {"value": 96.7, "device": "TPU v4",
+            "captured_at": "2026-07-01T00:00:00Z"})
+    monkeypatch.delenv("TPULAB_BENCH_NO_CARRY", raising=False)
+    monkeypatch.delenv("TPULAB_BENCH_CPU_FULL", raising=False)
+    monkeypatch.setattr(bench, "_state", {
+        "done": True, "phase": "emit", "device": "cpu", "degraded": True,
+        "details": {"b1_inf_s": 5.5}})
+    bench._emit_line()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["value"] == 5.5                       # LIVE headline
+    assert line["vs_baseline"] == round(5.5 / 953.4, 4)
+    assert "carried_forward" not in line
+    assert line["degraded"] is True
+    lg = line["last_good"]
+    assert lg["value"] == 96.7
+    assert lg["round"] == 3
+    assert lg["captured_at"] == "2026-07-01T00:00:00Z"
+    assert "d old" in lg["age"]
+    assert "LIVE degraded" in line["device"]
+
+
+def test_emit_on_device_saves_last_good(repo, monkeypatch, capsys):
+    monkeypatch.setenv("TPULAB_BENCH_ROUND", "4")
+    monkeypatch.setattr(bench, "_state", {
+        "done": True, "phase": "emit", "device": "TPU v4", "degraded": False,
+        "details": {"b1_inf_s": 150.0}})
+    bench._emit_line()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["value"] == 150.0 and "last_good" not in line
+    with open(bench.LAST_GOOD_PATH) as f:
+        store = json.load(f)
+    assert store["latest"]["value"] == 150.0
+    assert store["latest"]["round"] == 4
+    assert store["latest"]["captured_at"]
+    assert store["best"]["value"] == 150.0

@@ -149,6 +149,43 @@ def test_12_binary_codec_service():
     assert "binary-codec serving OK" in out.stdout
 
 
+def test_12_flatbuffers_service():
+    """Schema'd zero-copy FlatBuffers payloads (reference 12_FlatBuffers
+    example.fbs): round trip + parity with the local pipeline."""
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, f"{REPO}/examples/12_flatbuffers.py", "--cpu"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "flatbuffers serving OK" in out.stdout
+
+
+def test_99_run_lb_driver():
+    """The LB measurement driver (reference 99_LoadBalancer
+    run_loadbalancer.py): 2 replicas, direct + replicaset columns measured,
+    envoy skipped gracefully when the binary is absent."""
+    import json
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, f"{REPO}/examples/99_loadbalancer/run_lb.py",
+         "--replicas", "2", "-n", "40", "--cpu", "--json"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])["lb"]
+    assert rec["direct"]["inf_s"] > 0
+    assert rec["replicaset"]["inf_s"] > 0
+    # split counts the siege + warm + latency-probe requests; all of them
+    # completed through the set, spread over both replicas
+    assert sum(rec["replicaset"]["split"]) >= 40
+    assert all(s > 0 for s in rec["replicaset"]["split"])
+    assert "overhead_us_vs_direct" in rec["replicaset"]
+    assert "skipped" in rec["envoy"] or rec["envoy"]["inf_s"] > 0
+
+
 def test_06_stream_client_pipelines():
     """Standalone streaming middleman client (reference 04_Middleman
     middleman-client)."""

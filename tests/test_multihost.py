@@ -71,10 +71,10 @@ multihost.initialize(f"127.0.0.1:{coord_port}", num_processes=2,
 import jax
 assert jax.process_count() == 2
 
-from tpulab.engine.inference_manager import InferenceManager
+from tpulab._api import InferenceManager
 from tpulab.models.mnist import make_mnist
 
-mgr = InferenceManager(max_executions=2, max_buffers=8)
+mgr = InferenceManager(max_exec_concurrency=2, max_buffers=8)
 mgr.register_model("mnist", make_mnist(max_batch_size=8))
 mgr.update_resources()
 mgr.serve(port=serve_port, batching=True, batch_window_s=0.005)

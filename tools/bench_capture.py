@@ -35,10 +35,12 @@ def device_alive(deadline_s: float = 150.0) -> bool:
         return True  # probe machinery broken -> let the attempt decide
 
 
-def attempt(deadline_s: float) -> dict | None:
+def attempt(deadline_s: float, round_no: int = 0) -> dict | None:
     env = dict(os.environ)
     env["TPULAB_BENCH_DEADLINE_S"] = str(int(deadline_s - 60))
     env.setdefault("TPULAB_BENCH_CANARY_TRIES", "2")
+    if round_no:
+        env["TPULAB_BENCH_ROUND"] = str(round_no)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -97,13 +99,14 @@ def main() -> int:
             continue
         print(f"[bench_capture] attempt {n} at {time.strftime('%H:%M:%S')}",
               flush=True)
-        rec = attempt(args.attempt_deadline_s)
+        rec = attempt(args.attempt_deadline_s, round_no=args.round)
         if rec is not None:
             print(f"[bench_capture] got: {json.dumps(rec)[:300]}", flush=True)
             if is_real_device(rec):
                 rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                    time.gmtime())
                 rec["capture_attempt"] = n
+                rec["round"] = args.round
                 with open(out_path, "w") as f:
                     json.dump(rec, f, indent=2)
                 print(f"[bench_capture] REAL DEVICE NUMBER LANDED -> "

@@ -899,9 +899,11 @@ class ContinuousBatcher:
             _, _, lane = min(victims)
             self._preempt_locked(lane)
             if not self._admit_to_lane_locked(lane):
-                # reachable when every victim page was prefix-cache-shared
-                # (refcount > 1): releasing them freed nothing.  Safe to
-                # stop — the head retries next scheduling pass.
+                # Defensive: the victim filter above requires at least one
+                # refcount==1 page, so every preemption frees >=1 page and
+                # a one-page admit succeeds under the current filter.  Kept
+                # as a guard for future filter changes (e.g. admitting
+                # multi-page heads) — the head retries next scheduling pass.
                 return
 
     def _preempt_locked(self, lane: int) -> None:

@@ -1,8 +1,12 @@
 """Request-lifecycle contexts (reference context.h:41-158 + life_cycle_*.h).
 
-A Context class is instantiated per in-flight request (the reference pre-arms
-hundreds of reusable contexts on the CQ; grpc-python manages arming, so here a
-context is constructed per call — same surface, simpler lifetime).  Contexts
+A Context class is instantiated per in-flight request, and — like the
+reference's pre-armed CQ contexts — unary contexts are POOLED and recycled
+across requests (server._RPCDef free-lists).  The reuse contract: instance
+attributes set during ``execute_rpc`` are per-request state and are stripped
+when the context returns to the pool; only construction-time attributes
+survive recycling.  Streaming/batching contexts carry per-stream state and
+are never pooled.  Contexts
 see their service-wide :class:`~tpulab.core.resources.Resources` and timing
 hooks.
 

@@ -73,8 +73,9 @@ class Executor:
             else:
                 Affinity.set_affinity(self.cpus)
                 self.pinned.append(tuple(self.cpus))
-        except OSError:  # restricted environments (containers without
-            pass         # cpuset rights)
+        except (OSError, AttributeError, NotImplementedError):
+            pass  # restricted environments (no cpuset rights) or
+            #       platforms without sched_setaffinity (macOS/Windows)
 
     def build_worker_pool(self, max_workers: Optional[int] = None
                           ) -> _futures.ThreadPoolExecutor:
@@ -108,5 +109,6 @@ class FiberExecutor:
         try:
             from tpulab.core.affinity import Affinity
             Affinity.set_affinity([self.cpu])
-        except OSError:  # pragma: no cover - restricted environments
+        except (OSError, AttributeError,  # pragma: no cover - restricted
+                NotImplementedError):     # envs / non-Linux platforms
             pass

@@ -35,13 +35,32 @@ class ReplicaSet:
         self.model_name = model_name
         self._managers = [RemoteInferenceManager(a, channels=channels)
                           for a in self.addresses]
-        self._runners = [m.infer_runner(model_name) for m in self._managers]
-        self._inflight = [0] * len(self._runners)
+        # runners are built LAZILY per replica: constructing one performs a
+        # blocking Status RPC, and a replica that is down at construction
+        # (rolling restart) must count as a failed submission on that
+        # replica — not poison the whole set
+        self._runners: List[Optional[object]] = [None] * len(self._managers)
+        # per-replica creation locks: first contact is a blocking Status
+        # RPC, which must neither run twice per replica nor serialize
+        # against _pick/_submit bookkeeping on the shared lock
+        self._runner_locks = [threading.Lock() for _ in self._managers]
+        self._inflight = [0] * len(self._managers)
         #: requests completed per replica (observability / test assertions)
-        self.served = [0] * len(self._runners)
+        self.served = [0] * len(self._managers)
         self._lock = threading.Lock()
-        self._max_failover = (len(self._runners) if max_failover is None
+        self._rr = 0  # tie-break rotation cursor
+        self._max_failover = (len(self._managers) if max_failover is None
                               else max_failover)
+
+    def _runner(self, idx: int):
+        """The replica's runner, built on first use (raises if the replica
+        is unreachable — the caller treats that as a failed submission)."""
+        with self._runner_locks[idx]:
+            r = self._runners[idx]
+            if r is None:
+                r = self._managers[idx].infer_runner(self.model_name)
+                self._runners[idx] = r
+            return r
 
     # -- health -------------------------------------------------------------
     def health(self, timeout: float = 10.0) -> Dict[str, dict]:
@@ -49,8 +68,13 @@ class ReplicaSet:
         entries rather than raising — the set is expected to outlive
         individual replicas)."""
         out: Dict[str, dict] = {}
-        futs = [(a, m.health_async()) for a, m in zip(self.addresses,
-                                                      self._managers)]
+        futs = []
+        for a, m in zip(self.addresses, self._managers):
+            try:
+                futs.append((a, m.health_async()))
+            except Exception as e:  # noqa: BLE001 - submission itself failed
+                out[a] = {"live": False, "ready": False,
+                          "error": f"{type(e).__name__}: {e}"}
         for addr, fut in futs:
             try:
                 resp = fut.result(timeout=timeout)
@@ -62,12 +86,18 @@ class ReplicaSet:
 
     # -- dispatch -----------------------------------------------------------
     def _pick(self, exclude: frozenset) -> Optional[int]:
+        """Least-loaded with round-robin tie-breaking: sequential (zero-
+        inflight) traffic rotates across replicas instead of piling onto
+        index 0 (envoy's round-robin behavior at the tie)."""
         with self._lock:
             candidates = [(n, i) for i, n in enumerate(self._inflight)
                           if i not in exclude]
             if not candidates:
                 return None
-            _, idx = min(candidates)
+            lo = min(n for n, _ in candidates)
+            tied = [i for n, i in candidates if n == lo]
+            idx = tied[self._rr % len(tied)]
+            self._rr += 1
             self._inflight[idx] += 1
             return idx
 
@@ -105,8 +135,9 @@ class ReplicaSet:
                 outer.set_exception(exc)
 
         try:
-            self._runners[idx].infer(**arrays).add_done_callback(on_done)
-        except Exception as e:  # submission itself failed (dead channel)
+            self._runner(idx).infer(**arrays).add_done_callback(on_done)
+        except Exception as e:  # submission itself failed (dead channel
+            #                     or unreachable at first contact)
             with self._lock:
                 self._inflight[idx] -= 1
             if attempts_left > 1:

@@ -58,10 +58,20 @@ class _RPCDef:
         with self.ctx_pool_lock:
             if self.ctx_pool:
                 return self.ctx_pool.pop()
-        return self.context_cls(self.resources)
+        ctx = self.context_cls(self.resources)
+        # reuse contract: anything set during execute_rpc is per-request
+        # state and is stripped on release; only construction-time
+        # attributes survive recycling (so a pooled context looks freshly
+        # constructed to the next — possibly different — client).
+        ctx._pool_baseline = frozenset(ctx.__dict__) | {"_pool_baseline"}
+        return ctx
 
     def release_context(self, ctx) -> None:
         ctx.grpc_context = None
+        baseline = getattr(ctx, "_pool_baseline", None)
+        if baseline is not None:
+            for attr in [k for k in ctx.__dict__ if k not in baseline]:
+                del ctx.__dict__[attr]
         with self.ctx_pool_lock:
             if len(self.ctx_pool) < self.ctx_pool_cap:
                 self.ctx_pool.append(ctx)

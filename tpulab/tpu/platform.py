@@ -20,8 +20,14 @@ def _jax():
 
 @functools.lru_cache(maxsize=None)
 def devices(platform: Optional[str] = None) -> tuple:
-    """All addressable devices (reference DeviceInfo::Count enumeration)."""
-    return tuple(_jax().devices(platform) if platform else _jax().devices())
+    """All addressable devices (reference DeviceInfo::Count enumeration).
+
+    jax.local_devices, not jax.devices: under jax.distributed the global
+    list includes other processes' devices, and staging to a
+    non-addressable device raises — every consumer here (allocators,
+    engines, watchdog) wants THIS process's devices."""
+    return tuple(_jax().local_devices(backend=platform) if platform
+                 else _jax().local_devices())
 
 
 def device_count() -> int:
