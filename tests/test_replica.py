@@ -118,3 +118,175 @@ def test_exhaustion_error_when_all_replicas_dead():
 def test_constructor_rejects_empty():
     with pytest.raises(ValueError):
         ReplicaSet([], "mnist")
+
+
+# ---------------------------------------------------- generation routing ----
+def _serve_lm(engine_wrap=None):
+    import jax.numpy as jnp
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.transformer import init_transformer_params
+
+    params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                     n_layers=2, d_ff=64)
+    eng = GenerationEngine(params, n_heads=2, n_layers=2, max_len=64,
+                           max_sessions=2, compute_dtype=jnp.float32)
+    serve_eng = eng if engine_wrap is None else engine_wrap(eng)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": serve_eng})
+    return mgr, eng
+
+
+class _SlowStream:
+    """Delegating engine wrapper that paces token emission so a test can
+    deterministically kill a replica MID-stream."""
+
+    def __init__(self, inner, delay_s=0.05):
+        self._inner, self._delay = inner, delay_s
+
+    def start_session(self, timeout=None):
+        import contextlib
+        import time as _t
+        inner_cm = self._inner.start_session(timeout=timeout)
+        delay = self._delay
+
+        @contextlib.contextmanager
+        def cm():
+            with inner_cm as sess:
+                class Paced:
+                    def prefill(self, p):
+                        return sess.prefill(p)
+
+                    def stream(self, steps):
+                        for tok in sess.stream(steps):
+                            _t.sleep(delay)
+                            yield tok
+                yield Paced()
+        return cm()
+
+
+def test_generation_replicaset_routes_and_matches_local():
+    from tpulab.rpc.replica import GenerationReplicaSet
+    mgr_a, eng = _serve_lm()
+    mgr_b, _ = _serve_lm()  # identical params (fixed init seed)
+    grs = None
+    try:
+        addrs = [f"127.0.0.1:{m.server.bound_port}" for m in (mgr_a, mgr_b)]
+        grs = GenerationReplicaSet(addrs, "lm")
+        prompt = np.random.default_rng(0).integers(0, 64, (6,), np.int32)
+        expected = list(eng.generate(prompt[None, :], 8)[0])
+        for _ in range(2):  # sequential streams rotate across replicas
+            assert list(grs.generate(prompt, 8)) == expected
+        assert grs.served == [1, 1], grs.served
+        assert grs.inflight == [0, 0]
+    finally:
+        if grs is not None:
+            grs.close()
+        mgr_a.shutdown()
+        mgr_b.shutdown()
+
+
+def test_generation_failover_from_dead_first_replica():
+    """rr starts at the dead endpoint: the stream must transparently
+    replay on the live one, exactly-once, with zero tokens lost."""
+    from tests.conftest import free_port
+    from tpulab.rpc.replica import GenerationReplicaSet
+    mgr, eng = _serve_lm()
+    grs = None
+    try:
+        dead = f"127.0.0.1:{free_port()}"
+        live = f"127.0.0.1:{mgr.server.bound_port}"
+        grs = GenerationReplicaSet([dead, live], "lm")
+        prompt = np.arange(4, dtype=np.int32)
+        expected = list(eng.generate(prompt[None, :], 6)[0])
+        assert list(grs.generate(prompt, 6)) == expected
+        assert grs.served == [0, 1], grs.served
+    finally:
+        if grs is not None:
+            grs.close()
+        mgr.shutdown()
+
+
+def test_generation_mid_stream_failover_exactly_once():
+    """Kill the serving replica while its stream is mid-flight: the set
+    replays on the survivor, skips delivered tokens, and the consumer
+    sees the exact uninterrupted greedy sequence."""
+    import threading
+    from tpulab.rpc.replica import GenerationReplicaSet
+    mgr_a, eng = _serve_lm(engine_wrap=_SlowStream)
+    mgr_b, _ = _serve_lm(engine_wrap=_SlowStream)
+    mgrs = [mgr_a, mgr_b]
+    grs = None
+    killed = None
+    try:
+        addrs = [f"127.0.0.1:{m.server.bound_port}" for m in mgrs]
+        grs = GenerationReplicaSet(addrs, "lm")
+        prompt = np.arange(5, dtype=np.int32)
+        steps = 20
+        expected = list(eng.generate(prompt[None, :], steps)[0])
+        it = grs.generate(prompt, steps)
+        got = [next(it) for _ in range(3)]
+        active = grs.inflight.index(1)
+        killed = mgrs[active]
+        # zero-grace stop = a crash, not a drain (grace would let the
+        # paced stream finish on the dying replica); on a thread so a
+        # teardown wedge can never deadlock the consumer side
+        threading.Thread(target=lambda: killed.server.shutdown(grace_s=0.0),
+                         daemon=True).start()
+        got += list(it)
+        assert got == expected, (got, expected)
+        assert grs.served[1 - active] == 1, grs.served
+    finally:
+        if grs is not None:
+            grs.close()
+        for m in mgrs:
+            try:
+                m.shutdown()
+            except Exception:
+                pass
+
+
+def test_generation_seed_injected_for_sampled_requests():
+    """Sampling without a seed gets a client-side one (replay
+    determinism); greedy and explicitly-seeded requests pass through."""
+    from tpulab.rpc.replica import GenerationReplicaSet
+    mgr, _ = _serve_lm()
+    grs = None
+    try:
+        grs = GenerationReplicaSet(
+            [f"127.0.0.1:{mgr.server.bound_port}"], "lm")
+        seen = []
+        grs._generate_iter = lambda p, s, t, kw: iter([seen.append(kw)])
+        list(grs.generate([1, 2], 4, temperature=0.7))
+        assert seen[0].get("seed") is not None
+        list(grs.generate([1, 2], 4, temperature=0.7, seed=123))
+        assert seen[1]["seed"] == 123
+        list(grs.generate([1, 2], 4))
+        assert "seed" not in seen[2]
+    finally:
+        if grs is not None:
+            grs.close()
+        mgr.shutdown()
+
+
+def test_generation_rejection_does_not_fail_over():
+    """A request the server REJECTS (unknown model) is deterministic —
+    it must surface immediately, not replay across every replica."""
+    from tpulab.rpc.infer_service import GenerationRejected
+    from tpulab.rpc.replica import GenerationReplicaSet
+    mgr_a, _ = _serve_lm()
+    mgr_b, _ = _serve_lm()
+    grs = None
+    try:
+        addrs = [f"127.0.0.1:{m.server.bound_port}" for m in (mgr_a, mgr_b)]
+        grs = GenerationReplicaSet(addrs, "nope")
+        with pytest.raises(GenerationRejected, match="no generation engine"):
+            list(grs.generate([1, 2, 3], 4))
+        assert grs._rr == 1, "rejection must consume exactly one pick"
+        assert grs.inflight == [0, 0]
+    finally:
+        if grs is not None:
+            grs.close()
+        mgr_a.shutdown()
+        mgr_b.shutdown()

@@ -20,6 +20,9 @@ grpc-python:
   (reference client/*.h)
 - :mod:`infer_service` — the TRTIS-protocol inference service + remote
   client (reference pybind BasicInferService / PyRemoteInferenceManager)
+- :mod:`replica` — client-side replica sets (:class:`ReplicaSet` unary,
+  :class:`GenerationReplicaSet` token streams): least-loaded routing,
+  health, exactly-once failover (SURVEY §2.8 axes 5-6 in-framework)
 """
 
 from tpulab.rpc.context import Context, StreamingContext, BatchingContext

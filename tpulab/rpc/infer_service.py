@@ -501,6 +501,24 @@ class GenerateContext(StreamingContext):
                 code=pb.INTERNAL, message=str(e))))
 
 
+class GenerationRejected(RuntimeError):
+    """The server PROCESSED the request and rejected it with a final
+    status (UNKNOWN_MODEL / INVALID_ARGUMENT / INTERNAL) — as opposed to
+    transport errors (grpc.RpcError), which mean the replica itself is
+    unreachable.  Routers use the distinction: a rejection is the same on
+    every replica and must not fail over."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(f"generation failed: {message}")
+        self.code = code
+
+    @property
+    def retryable(self) -> bool:
+        """INTERNAL may be a transient engine fault; deterministic
+        request errors are not worth a second replica's time."""
+        return self.code not in (pb.UNKNOWN_MODEL, pb.INVALID_ARGUMENT)
+
+
 class GenerateStreamClient:
     """Client: ``generate(prompt, steps)`` yields tokens as they stream."""
 
@@ -546,8 +564,8 @@ class GenerateStreamClient:
                 if resp.final:
                     finished = True
                     if resp.status.code not in (pb.SUCCESS, 0):
-                        raise RuntimeError(
-                            f"generation failed: {resp.status.message}")
+                        raise GenerationRejected(resp.status.code,
+                                                 resp.status.message)
                     return
                 yield ((resp.token, resp.logprob) if return_logprobs
                        else resp.token)

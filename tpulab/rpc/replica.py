@@ -1,4 +1,4 @@
-"""Cross-process replica routing: a client-side replica set over remote
+"""Cross-process replica routing: client-side replica sets over remote
 inference endpoints.
 
 The reference scales out with N single-GPU services behind an L7 balancer
@@ -9,9 +9,20 @@ health-checks them, routes each request to the least-loaded live replica
 and fails a request over to the next replica when one dies mid-flight
 (inference is idempotent — a retry cannot corrupt state).
 
+:class:`GenerationReplicaSet` extends the same routing to token-streaming
+generation (beyond-reference: the trtlab serving surface has no
+generation path).  Failover here must respect server-side state: a
+generation is deterministic given (prompt, steps, sampling params, seed)
+— greedy decoding by construction, sampled decoding because the engines
+key their Gumbel streams by (seed, position), independent of batch
+composition.  The set therefore injects a client-side seed when sampling
+without one, and on a mid-stream replica death REPLAYS the request on
+another replica, skipping the tokens already delivered — the consumer
+sees one uninterrupted, exactly-once token stream.
+
 Complements, not replaces, a real L7 balancer: envoy owns cross-client
-balancing in deployment (examples/99_loadbalancer); ReplicaSet gives one
-process the same behavior with zero infrastructure — and is what the
+balancing in deployment (examples/99_loadbalancer); these sets give one
+process the same behavior with zero infrastructure — and are what the
 multihost serving test drives across two jax.distributed processes.
 """
 
@@ -21,11 +32,13 @@ import threading
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
-from tpulab.rpc.infer_service import RemoteInferenceManager
+from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                      RemoteInferenceManager)
 
 
-class ReplicaSet:
-    """Least-loaded router with failover over remote replicas."""
+class _BaseReplicaSet:
+    """Shared routing state: least-loaded pick with round-robin
+    tie-breaking, per-replica health, inflight/served accounting."""
 
     def __init__(self, addresses: Sequence[str], model_name: str,
                  channels: int = 1, max_failover: Optional[int] = None):
@@ -35,15 +48,6 @@ class ReplicaSet:
         self.model_name = model_name
         self._managers = [RemoteInferenceManager(a, channels=channels)
                           for a in self.addresses]
-        # runners are built LAZILY per replica: constructing one performs a
-        # blocking Status RPC, and a replica that is down at construction
-        # (rolling restart) must count as a failed submission on that
-        # replica — not poison the whole set
-        self._runners: List[Optional[object]] = [None] * len(self._managers)
-        # per-replica creation locks: first contact is a blocking Status
-        # RPC, which must neither run twice per replica nor serialize
-        # against _pick/_submit bookkeeping on the shared lock
-        self._runner_locks = [threading.Lock() for _ in self._managers]
         self._inflight = [0] * len(self._managers)
         #: requests completed per replica (observability / test assertions)
         self.served = [0] * len(self._managers)
@@ -51,16 +55,6 @@ class ReplicaSet:
         self._rr = 0  # tie-break rotation cursor
         self._max_failover = (len(self._managers) if max_failover is None
                               else max_failover)
-
-    def _runner(self, idx: int):
-        """The replica's runner, built on first use (raises if the replica
-        is unreachable — the caller treats that as a failed submission)."""
-        with self._runner_locks[idx]:
-            r = self._runners[idx]
-            if r is None:
-                r = self._managers[idx].infer_runner(self.model_name)
-                self._runners[idx] = r
-            return r
 
     # -- health -------------------------------------------------------------
     def health(self, timeout: float = 10.0) -> Dict[str, dict]:
@@ -101,6 +95,51 @@ class ReplicaSet:
             self._inflight[idx] += 1
             return idx
 
+    def _pick_or_any(self, exclude: frozenset) -> Optional[int]:
+        idx = self._pick(exclude)
+        if idx is None:  # every replica already failed this request
+            idx = self._pick(frozenset())
+        return idx
+
+    @property
+    def inflight(self) -> List[int]:
+        with self._lock:
+            return list(self._inflight)
+
+    def close(self) -> None:
+        for m in self._managers:
+            try:
+                m.close()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
+
+
+class ReplicaSet(_BaseReplicaSet):
+    """Least-loaded router with failover over remote unary replicas."""
+
+    def __init__(self, addresses: Sequence[str], model_name: str,
+                 channels: int = 1, max_failover: Optional[int] = None):
+        super().__init__(addresses, model_name, channels, max_failover)
+        # runners are built LAZILY per replica: constructing one performs a
+        # blocking Status RPC, and a replica that is down at construction
+        # (rolling restart) must count as a failed submission on that
+        # replica — not poison the whole set
+        self._runners: List[Optional[object]] = [None] * len(self._managers)
+        # per-replica creation locks: first contact is a blocking Status
+        # RPC, which must neither run twice per replica nor serialize
+        # against _pick/_submit bookkeeping on the shared lock
+        self._runner_locks = [threading.Lock() for _ in self._managers]
+
+    def _runner(self, idx: int):
+        """The replica's runner, built on first use (raises if the replica
+        is unreachable — the caller treats that as a failed submission)."""
+        with self._runner_locks[idx]:
+            r = self._runners[idx]
+            if r is None:
+                r = self._managers[idx].infer_runner(self.model_name)
+                self._runners[idx] = r
+            return r
+
     def infer(self, **arrays) -> Future:
         """Future of the outputs dict; rides the least-loaded replica and
         fails over (re-submits) when a replica errors mid-flight."""
@@ -111,9 +150,7 @@ class ReplicaSet:
 
     def _submit(self, outer: Future, arrays: dict, attempts_left: int,
                 exclude: frozenset) -> None:
-        idx = self._pick(exclude)
-        if idx is None:  # every replica already failed this request
-            idx = self._pick(frozenset())
+        idx = self._pick_or_any(exclude)
         if idx is None:  # unreachable: >=1 replica by construction
             outer.set_exception(RuntimeError("no replicas"))
             return
@@ -146,14 +183,65 @@ class ReplicaSet:
             else:
                 outer.set_exception(e)
 
-    @property
-    def inflight(self) -> List[int]:
-        with self._lock:
-            return list(self._inflight)
 
-    def close(self) -> None:
-        for m in self._managers:
+class GenerationReplicaSet(_BaseReplicaSet):
+    """Least-loaded routing + exactly-once replay failover for
+    token-streaming generation (module docstring: determinism contract)."""
+
+    def __init__(self, addresses: Sequence[str], model_name: str,
+                 channels: int = 1, max_failover: Optional[int] = None):
+        super().__init__(addresses, model_name, channels, max_failover)
+        self._clients = [GenerateStreamClient(m, model_name)
+                        for m in self._managers]
+
+    def generate(self, prompt, steps: int, timeout: float = 300.0, **kw):
+        """Token iterator with transparent failover.
+
+        Sampling without an explicit seed gets a client-side one so a
+        replayed request reproduces the identical token sequence on any
+        replica; tokens already delivered are skipped on replay, so the
+        consumer sees each position exactly once.
+        """
+        import numpy as np
+        if kw.get("temperature", 0.0) and kw.get("seed") is None:
+            import secrets
+            kw["seed"] = secrets.randbits(63)
+        prompt = list(np.asarray(prompt, np.int32))
+        return self._generate_iter(prompt, steps, timeout, kw)
+
+    def _generate_iter(self, prompt, steps, timeout, kw):
+        delivered = 0
+        attempts_left = self._max_failover
+        exclude: set = set()
+        while True:
+            idx = self._pick_or_any(frozenset(exclude))
+            if idx is None:
+                raise RuntimeError("no replicas")
+            gen = None
             try:
-                m.close()
-            except Exception:  # pragma: no cover - teardown best-effort
-                pass
+                gen = self._clients[idx].generate(prompt, steps,
+                                                  timeout=timeout, **kw)
+                i = 0
+                for item in gen:
+                    if i >= delivered:  # replay skips what the consumer has
+                        delivered += 1
+                        yield item
+                    i += 1
+                with self._lock:
+                    self.served[idx] += 1
+                return
+            except Exception as e:
+                from tpulab.rpc.infer_service import GenerationRejected
+                if isinstance(e, GenerationRejected) and not e.retryable:
+                    # the server processed and rejected the request —
+                    # identical on every replica, don't burn them all
+                    raise
+                attempts_left -= 1
+                exclude.add(idx)
+                if attempts_left <= 0:
+                    raise
+            finally:
+                with self._lock:
+                    self._inflight[idx] -= 1
+                if gen is not None:
+                    gen.close()  # abandoned inner stream cancels promptly
