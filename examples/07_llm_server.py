@@ -12,6 +12,10 @@ Server:
 Client (separate shell):
     python examples/07_llm_server.py --cpu --connect localhost:50055 \
         --prompt 1,2,3 --steps 16 --temperature 0.8 --seed 7
+Replicated client (comma-separated endpoints = least-loaded routing with
+exactly-once crash failover via GenerationReplicaSet):
+    python examples/07_llm_server.py --cpu \
+        --connect localhost:50055,localhost:50056 --prompt 1,2,3
 
 The reference has no LLM serving (trtlab predates it); this example is the
 "switch from the reference" landing spot for generative workloads — the
@@ -63,18 +67,32 @@ def main():
     import numpy as np
 
     if args.connect:
+        prompt = np.asarray([int(t) for t in args.prompt.split(",")],
+                            np.int32)
+        stops = [args.stop_token] if args.stop_token is not None else ()
+        kw = dict(temperature=args.temperature, seed=args.seed,
+                  priority=args.priority, stop_tokens=stops,
+                  device_sampling=args.device_sampling)
+        if "," in args.connect:
+            # N replicas: least-loaded routing + exactly-once crash
+            # failover (tpulab.rpc.replica.GenerationReplicaSet) — the
+            # generation analog of examples/99's scale-out
+            from tpulab.rpc.replica import GenerationReplicaSet
+            addrs = [a.strip() for a in args.connect.split(",") if a.strip()]
+            grs = GenerationReplicaSet(addrs, "llm")
+            try:
+                for tok in grs.generate(prompt, args.steps, **kw):
+                    print(tok, end=" ", flush=True)
+                by = ", ".join(f"{a}={n}" for a, n in zip(addrs, grs.served))
+                print(f"\ndone (requests per replica: {by})")
+            finally:
+                grs.close()
+            return 0
         from tpulab.rpc.infer_service import (GenerateStreamClient,
                                               RemoteInferenceManager)
         remote = RemoteInferenceManager(args.connect)
-        prompt = np.asarray([int(t) for t in args.prompt.split(",")],
-                            np.int32)
         client = GenerateStreamClient(remote, "llm")
-        stops = [args.stop_token] if args.stop_token is not None else ()
-        for tok in client.generate(prompt, args.steps,
-                                   temperature=args.temperature,
-                                   seed=args.seed, priority=args.priority,
-                                   stop_tokens=stops,
-                                   device_sampling=args.device_sampling):
+        for tok in client.generate(prompt, args.steps, **kw):
             print(tok, end=" ", flush=True)
         print("\ndone")
         remote.close()
