@@ -296,32 +296,44 @@ def test_notebook_llm_serving():
     assert out.stdout.strip().endswith("done")
 
 
+def _spawn_llm_server(env, *extra_args):
+    return subprocess.Popen(
+        [sys.executable, f"{REPO}/examples/07_llm_server.py", "--cpu",
+         "--port", "0", "--oneshot", "--max-len", "128", "--lanes", "2",
+         *extra_args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+
+
+def _wait_llm_port(srv, deadline_s=120.0):
+    """Port from the server banner, with the deadline ENFORCED via select
+    — a server that stays alive but never prints must fail the test, not
+    hang readline() (and with it the whole pytest run) forever."""
+    import select
+    seen, deadline = [], time.time() + deadline_s
+    while time.time() < deadline:
+        if srv.poll() is not None:
+            raise AssertionError("server died at startup:\n" + "".join(seen))
+        ready, _, _ = select.select([srv.stdout], [], [],
+                                    min(1.0, deadline - time.time()))
+        if not ready:
+            continue
+        line = srv.stdout.readline()
+        seen.append(line)
+        if "LLM server on :" in line:
+            return line.split("LLM server on :")[1].split()[0]
+    raise AssertionError("server never came up:\n" + "".join(seen))
+
+
 def test_07_llm_server_end_to_end():
     """The LLM server example: int8 weights + fp8 KV + prefix cache behind
     the Generate RPC, driven by its own client mode across processes."""
     env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
            "TPULAB_FORCE_CPU": "1", "HOME": "/tmp"}
-    srv = subprocess.Popen(
-        [sys.executable, f"{REPO}/examples/07_llm_server.py", "--cpu",
-         "--port", "0", "--oneshot", "--int8", "--kv-fp8",
-         "--max-len", "128", "--lanes", "2"],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env)
+    srv = _spawn_llm_server(env, "--int8", "--kv-fp8")
     try:
-        port = None
-        seen = []
-        deadline = time.time() + 120
-        while time.time() < deadline:
-            if srv.poll() is not None:
-                raise AssertionError(
-                    "server died at startup:\n" + "".join(seen))
-            line = srv.stdout.readline()
-            seen.append(line)
-            if "LLM server on :" in line:
-                port = line.split("LLM server on :")[1].split()[0]
-                break
-        assert port, "server never came up:\n" + "".join(seen)
+        port = _wait_llm_port(srv)
         out = subprocess.run(
             [sys.executable, f"{REPO}/examples/07_llm_server.py", "--cpu",
              "--connect", f"localhost:{port}", "--prompt", "5,6,7",
@@ -333,3 +345,30 @@ def test_07_llm_server_end_to_end():
         assert srv.wait(timeout=60) == 0  # oneshot exit
     finally:
         srv.kill()
+
+
+def test_07_llm_server_replicated_client():
+    """07's comma-separated --connect: two real server processes, the
+    client routes through GenerationReplicaSet and reports per-replica
+    counts (the generation analog of the 98/99 scale-out scripts)."""
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "TPULAB_FORCE_CPU": "1", "HOME": "/tmp"}
+    srvs = []
+    try:
+        for _ in range(2):  # spawn INSIDE the try: a failed second spawn
+            srvs.append(_spawn_llm_server(env))  # must not leak the first
+        ports = [_wait_llm_port(srv) for srv in srvs]
+        # whitespace after the comma exercises the tolerant parsing
+        target = f"localhost:{ports[0]}, localhost:{ports[1]}"
+        out = subprocess.run(
+            [sys.executable, f"{REPO}/examples/07_llm_server.py", "--cpu",
+             "--connect", target, "--prompt", "5,6,7", "--steps", "6"],
+            capture_output=True, text=True, timeout=180, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        toks = out.stdout.split("\n")[0].split()
+        assert len(toks) == 6, out.stdout
+        assert "requests per replica" in out.stdout
+    finally:
+        for srv in srvs:
+            srv.kill()
