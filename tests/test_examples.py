@@ -306,19 +306,31 @@ def _spawn_llm_server(env, *extra_args):
 
 
 def _wait_llm_port(srv, deadline_s=120.0):
-    """Port from the server banner, with the deadline ENFORCED via select
-    — a server that stays alive but never prints must fail the test, not
-    hang readline() (and with it the whole pytest run) forever."""
-    import select
+    """Port from the server banner, deadline ENFORCED — a server that
+    stays alive but never prints must fail the test, not hang readline()
+    (and with it the whole pytest run) forever.  A daemon pump thread
+    owns the blocking reads (select on the raw fd would lie: readline's
+    TextIOWrapper buffer can already hold the banner), and keeps draining
+    the merged stdout/stderr pipe after the banner so the server can
+    never block on a full pipe."""
+    import queue
+    q = queue.Queue()
+
+    def pump():
+        for line in srv.stdout:
+            q.put(line)
+
+    threading.Thread(target=pump, daemon=True).start()
     seen, deadline = [], time.time() + deadline_s
     while time.time() < deadline:
-        if srv.poll() is not None:
-            raise AssertionError("server died at startup:\n" + "".join(seen))
-        ready, _, _ = select.select([srv.stdout], [], [],
-                                    min(1.0, deadline - time.time()))
-        if not ready:
+        try:
+            line = q.get(timeout=max(0.0, min(1.0,
+                                              deadline - time.time())))
+        except queue.Empty:
+            if srv.poll() is not None:
+                raise AssertionError("server died at startup:\n"
+                                     + "".join(seen))
             continue
-        line = srv.stdout.readline()
         seen.append(line)
         if "LLM server on :" in line:
             return line.split("LLM server on :")[1].split()[0]

@@ -1,0 +1,95 @@
+"""ViT model family: forward correctness properties, the uint8 ingress
+path, serving through the full pipeline, and W8A16 quantization reuse
+(the layer dict intentionally matches the text transformer's)."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tiny_vit():
+    from tpulab.models.vit import init_vit_params, make_vit
+    params = init_vit_params("s", image_size=32, patch_size=16,
+                            num_classes=10)
+    return make_vit("s", image_size=32, patch_size=16, num_classes=10,
+                    max_batch_size=4, batch_buckets=[2, 4], params=params)
+
+
+def test_forward_shape_and_finite(tiny_vit):
+    x = np.random.default_rng(0).standard_normal(
+        (2, 32, 32, 3)).astype(np.float32)
+    out = tiny_vit.apply_fn(tiny_vit.params, {"input": x})
+    assert out["logits"].shape == (2, 10)
+    assert np.all(np.isfinite(np.asarray(out["logits"])))
+
+
+def test_uint8_ingress_matches_normalized_float(tiny_vit):
+    """The serving path's on-device normalization equals feeding the
+    normalized float image (the INT8-parity ingress contract)."""
+    import jax.numpy as jnp
+
+    from tpulab.models.resnet import IMAGENET_MEAN, IMAGENET_STD
+    from tpulab.models.vit import vit_apply
+    rng = np.random.default_rng(1)
+    raw = rng.integers(0, 255, (2, 32, 32, 3)).astype(np.uint8)
+    norm = ((raw.astype(np.float32) / 255.0 - np.asarray(IMAGENET_MEAN))
+            / np.asarray(IMAGENET_STD)).astype(np.float32)
+    kw = dict(n_heads=6, n_layers=12, patch_size=16,
+              compute_dtype=jnp.float32)
+    a = vit_apply(tiny_vit.params, {"input": raw}, **kw)["logits"]
+    # match the uint8 path's arithmetic ((x - 255*mean) / (255*std))
+    b = vit_apply(tiny_vit.params, {"input": norm * 1.0}, **kw)["logits"]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_patch_count_validation():
+    from tpulab.models.vit import init_vit_params
+    with pytest.raises(ValueError, match="not divisible"):
+        init_vit_params("s", image_size=100, patch_size=16)
+
+
+def test_registry_builds_and_serves():
+    from tpulab.engine import InferenceManager
+    from tpulab.models import build_model
+    model = build_model("vit_s32", image_size=64, num_classes=10,
+                        max_batch_size=2, batch_buckets=[1, 2],
+                        input_dtype=np.uint8)
+    assert model.name == "vit_s32"
+    mgr = InferenceManager(max_executions=2, max_buffers=4)
+    mgr.register_model("vit", model)
+    mgr.update_resources()
+    try:
+        x = np.random.default_rng(2).integers(
+            0, 255, (2, 64, 64, 3)).astype(np.uint8)
+        out = mgr.infer_runner("vit").infer(input=x).result(timeout=120)
+        assert out["logits"].shape == (2, 10)
+        assert np.all(np.isfinite(out["logits"]))
+        # bucket padding: a batch-1 request rides the 1-bucket
+        out1 = mgr.infer_runner("vit").infer(input=x[:1]).result(timeout=120)
+        np.testing.assert_allclose(out1["logits"], out["logits"][:1],
+                                   rtol=2e-2, atol=2e-2)
+    finally:
+        mgr.shutdown()
+
+
+def test_w8a16_quantization_applies():
+    """The text transformer's weight-only INT8 walker quantizes ViT
+    layers unchanged (shared layer dict layout is load-bearing)."""
+    import jax.numpy as jnp
+
+    from tpulab.models.quantization import quantize_transformer_params
+    from tpulab.models.vit import init_vit_params, vit_apply
+    params = init_vit_params("s", image_size=32, patch_size=16,
+                            num_classes=10)
+    qp = quantize_transformer_params(params)
+    assert qp["layer0"]["wqkv"]["w_int8"].dtype == jnp.int8
+    x = np.random.default_rng(3).standard_normal(
+        (1, 32, 32, 3)).astype(np.float32)
+    kw = dict(n_heads=6, n_layers=12, patch_size=16,
+              compute_dtype=jnp.float32)
+    a = np.asarray(vit_apply(params, {"input": x}, **kw)["logits"])
+    b = np.asarray(vit_apply(qp, {"input": x}, **kw)["logits"])
+    assert np.all(np.isfinite(b))
+    corr = float(np.corrcoef(a.ravel(), b.ravel())[0, 1])
+    assert corr > 0.98, corr

@@ -23,12 +23,25 @@ def _transformer(**kw):
     return make_transformer(**kw)
 
 
+def _vit(variant: str, patch: int):
+    def build(**kw):
+        from tpulab.models.vit import make_vit
+        return make_vit(variant=variant, patch_size=patch, **kw)
+    return build
+
+
 _REGISTRY: Dict[str, Callable] = {
     "resnet50": _resnet(50),
     "resnet101": _resnet(101),
     "resnet152": _resnet(152),
     "mnist": _mnist,
     "transformer": _transformer,
+    "vit_s16": _vit("s", 16),
+    "vit_b16": _vit("b", 16),
+    "vit_l16": _vit("l", 16),
+    "vit_s32": _vit("s", 32),
+    "vit_b32": _vit("b", 32),
+    "vit_l32": _vit("l", 32),
 }
 
 

@@ -1,0 +1,146 @@
+"""Vision Transformer family (ViT-S/B/L at /16 or /32 patching).
+
+The reference's model zoo is ONNX-engine classifiers with ResNet-50 as
+the flagship (reference examples/00_TensorRT, models/setup.py); this adds
+the transformer-class image model the TPU way rather than porting an ONNX
+graph:
+
+- **Patch embedding is one reshape + matmul**: (B, H, W, C) reshapes to
+  (B, N, p*p*C) — a free layout change — and a single (p*p*C, d) matmul
+  embeds every patch on the MXU.  No conv, no im2col materialization.
+- **Encoder blocks reuse the transformer trunk primitives**
+  (:mod:`tpulab.models.transformer`: ``_rmsnorm``, ``dense_attention``,
+  ``qmat``) — pre-norm blocks with non-causal attention.  RMSNorm instead
+  of classic LayerNorm is a deliberate in-house choice: one fused
+  rsqrt-scale, no mean subtraction or bias, same layer dict layout as the
+  text transformer so weight-only INT8 (``quantize_transformer_params``)
+  applies unchanged.
+- **uint8 ingress** shares ResNet's INT8-parity serving path: raw pixel
+  bytes over wire/staging (4x less ingress), normalization fused on
+  device.
+
+Servable via ``build_model("vit_s16" | "vit_b16" | ...)`` with batch
+buckets like every zoo model.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpulab.models.resnet import IMAGENET_MEAN, IMAGENET_STD
+from tpulab.models.transformer import _rmsnorm, dense_attention, qmat
+
+_GEOMETRIES = {  # name -> (d_model, n_heads, n_layers, d_ff)
+    "s": (384, 6, 12, 1536),
+    "b": (768, 12, 12, 3072),
+    "l": (1024, 16, 24, 4096),
+}
+
+
+def init_vit_params(variant: str = "s", image_size: int = 224,
+                    patch_size: int = 16, num_classes: int = 1000,
+                    seed: int = 0) -> Dict[str, Any]:
+    d_model, n_heads, n_layers, d_ff = _GEOMETRIES[variant]
+    if image_size % patch_size:
+        raise ValueError(f"image {image_size} not divisible by patch "
+                         f"{patch_size}")
+    n_patches = (image_size // patch_size) ** 2
+    rng = jax.random.PRNGKey(seed)
+    keys = iter(jax.random.split(rng, n_layers + 5))
+    s = 0.02
+    params: Dict[str, Any] = {
+        "patch_embed": jax.random.normal(
+            next(keys), (patch_size * patch_size * 3, d_model)) * s,
+        "cls": jax.random.normal(next(keys), (d_model,)) * s,
+        "pos_embed": jax.random.normal(
+            next(keys), (n_patches + 1, d_model)) * s,
+        "final_norm": {"scale": jnp.ones((d_model,))},
+        "head": {
+            "kernel": jax.random.normal(next(keys),
+                                        (d_model, num_classes)) * s,
+            "bias": jnp.zeros((num_classes,)),
+        },
+    }
+    for i in range(n_layers):
+        lkeys = iter(jax.random.split(next(keys), 4))
+        params[f"layer{i}"] = {
+            "ln1": {"scale": jnp.ones((d_model,))},
+            "ln2": {"scale": jnp.ones((d_model,))},
+            "wqkv": jax.random.normal(next(lkeys),
+                                      (d_model, 3 * d_model)) * s,
+            "wo": jax.random.normal(next(lkeys), (d_model, d_model)) * s,
+            "w1": jax.random.normal(next(lkeys), (d_model, d_ff)) * s,
+            "w2": jax.random.normal(next(lkeys), (d_ff, d_model)) * s,
+        }
+    return params
+
+
+def vit_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
+              n_heads: int, n_layers: int, patch_size: int = 16,
+              compute_dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+    """Forward: NHWC image -> logits (binding names: input / logits).
+    uint8 inputs are normalized on device, like the ResNet serving path."""
+    x = inputs["input"]
+    if x.dtype == jnp.uint8:
+        mean = jnp.asarray(IMAGENET_MEAN, compute_dtype) * 255.0
+        std = jnp.asarray(IMAGENET_STD, compute_dtype) * 255.0
+        x = (x.astype(compute_dtype) - mean) / std
+    else:
+        x = x.astype(compute_dtype)
+    b, hh, ww, c = x.shape
+    p = patch_size
+    # patchify = pure layout: (B, Hp, p, Wp, p, C) -> (B, N, p*p*C)
+    x = x.reshape(b, hh // p, p, ww // p, p, c).transpose(0, 1, 3, 2, 4, 5)
+    x = x.reshape(b, (hh // p) * (ww // p), p * p * c)
+    x = x @ qmat(params["patch_embed"], compute_dtype)
+    cls = jnp.broadcast_to(params["cls"].astype(compute_dtype),
+                           (b, 1, x.shape[-1]))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(compute_dtype)[None]
+    t, d_model = x.shape[1], x.shape[2]
+    head_dim = d_model // n_heads
+    for i in range(n_layers):
+        lp = params[f"layer{i}"]
+        h = _rmsnorm(x, lp["ln1"]["scale"].astype(compute_dtype))
+        qkv = h @ qmat(lp["wqkv"], compute_dtype)
+        q, k, v = (qkv[..., j * d_model:(j + 1) * d_model]
+                   .reshape(b, t, n_heads, head_dim) for j in range(3))
+        attn = dense_attention(q, k, v, causal=False).reshape(b, t, d_model)
+        x = x + attn @ qmat(lp["wo"], compute_dtype)
+        h = _rmsnorm(x, lp["ln2"]["scale"].astype(compute_dtype))
+        x = x + (jax.nn.gelu(h @ qmat(lp["w1"], compute_dtype))
+                 @ qmat(lp["w2"], compute_dtype)).astype(x.dtype)
+    x = _rmsnorm(x, params["final_norm"]["scale"].astype(compute_dtype))
+    logits = (x[:, 0].astype(jnp.float32) @ params["head"]["kernel"]
+              + params["head"]["bias"])
+    return {"logits": logits}
+
+
+def make_vit(variant: str = "s", image_size: int = 224,
+             patch_size: int = 16, num_classes: int = 1000,
+             max_batch_size: int = 8, compute_dtype=jnp.bfloat16,
+             seed: int = 0, input_dtype=np.float32, batch_buckets=None,
+             params=None):
+    """Build a servable ViT Model (same surface as :func:`make_resnet`)."""
+    from tpulab.engine.model import IOSpec, Model
+
+    _, n_heads, n_layers, _ = _GEOMETRIES[variant]
+    if params is None:
+        params = init_vit_params(variant, image_size, patch_size,
+                                 num_classes, seed)
+    apply_fn = partial(vit_apply, n_heads=n_heads, n_layers=n_layers,
+                       patch_size=patch_size, compute_dtype=compute_dtype)
+    return Model(
+        name=f"vit_{variant}{patch_size}",
+        apply_fn=apply_fn,
+        params=params,
+        inputs=[IOSpec("input", (image_size, image_size, 3), input_dtype)],
+        outputs=[IOSpec("logits", (num_classes,), np.float32)],
+        max_batch_size=max_batch_size,
+        batch_buckets=batch_buckets,
+    )
