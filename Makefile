@@ -1,8 +1,8 @@
 # tpulab build/test targets (reference Makefile/build.sh analog).
 PY ?= python
 
-.PHONY: all native test test-native bench bench-native bench-host dryrun \
-        engine clean
+.PHONY: all native test test-native test-native-tsan bench bench-native \
+        bench-host dryrun engine clean
 
 all: native test
 
@@ -15,6 +15,13 @@ test:
 
 test-native: native
 	./cpp/build/test_native
+
+# race detection for the native core (beyond-reference: trtlab wires no
+# sanitizers); clean run = futex mutex / pools / thread pool race-free
+test-native-tsan:
+	cmake -S cpp -B cpp/build-tsan -G Ninja -DTPULAB_TSAN=ON
+	ninja -C cpp/build-tsan test_native_tsan
+	./cpp/build-tsan/test_native_tsan
 
 bench-native: native
 	./cpp/build/bench_native
@@ -33,5 +40,5 @@ engine:
 	    --max-batch 128 --out engines/rn50
 
 clean:
-	rm -rf cpp/build .pytest_cache
+	rm -rf cpp/build cpp/build-tsan .pytest_cache
 	find . -name __pycache__ -type d -exec rm -rf {} +
