@@ -290,3 +290,54 @@ def test_generation_rejection_does_not_fail_over():
             grs.close()
         mgr_a.shutdown()
         mgr_b.shutdown()
+
+
+def test_replica_recovers_after_restart_on_same_port():
+    """Rolling-restart story: a replica dies, traffic fails over; it
+    comes back on the SAME address and the set resumes using it (grpc
+    channels reconnect; no ReplicaSet rebuild needed)."""
+    from tests.conftest import free_port
+    port_b = free_port()
+
+    def serve_on(port):
+        mgr = tpulab.InferenceManager(max_exec_concurrency=1, max_buffers=4)
+        mgr.register_model("mnist", make_mnist(max_batch_size=2))
+        mgr.update_resources()
+        mgr.serve(port=port)
+        return mgr
+
+    mgr_a = mgr_b = rs = None
+    try:
+        mgr_a = _serve_mnist()
+        mgr_b = serve_on(port_b)
+        addrs = [f"127.0.0.1:{mgr_a.server.bound_port}",
+                 f"127.0.0.1:{port_b}"]
+        rs = ReplicaSet(addrs, "mnist")
+        for _ in range(4):
+            rs.infer(Input3=X).result(timeout=60)
+        mgr_b.shutdown()  # replica 1 goes dark...
+        for _ in range(4):
+            rs.infer(Input3=X).result(timeout=60)  # ...failover carries on
+        assert not rs.health()[addrs[1]]["live"]
+        mgr_b = serve_on(port_b)  # ...and comes back on the same port
+        import time
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if rs.health()[addrs[1]]["live"]:
+                break
+            time.sleep(0.2)  # grpc reconnect backoff; don't busy-spin
+        else:
+            raise AssertionError("restarted replica never became live")
+        served_before = rs.served[1]
+        for _ in range(8):
+            rs.infer(Input3=X).result(timeout=60)
+        assert rs.served[1] > served_before, rs.served  # traffic returned
+    finally:
+        if rs is not None:
+            rs.close()
+        for m in (mgr_a, mgr_b):
+            try:
+                if m is not None:
+                    m.shutdown()
+            except Exception:
+                pass
