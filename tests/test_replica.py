@@ -13,12 +13,12 @@ from tpulab.rpc.replica import ReplicaSet
 X = np.zeros((1, 28, 28, 1), np.float32)
 
 
-def _serve_mnist(max_exec=1, max_buffers=4):
+def _serve_mnist(max_exec=1, max_buffers=4, port=0):
     mgr = tpulab.InferenceManager(max_exec_concurrency=max_exec,
                                   max_buffers=max_buffers)
     mgr.register_model("mnist", make_mnist(max_batch_size=2))
     mgr.update_resources()
-    mgr.serve(port=0)
+    mgr.serve(port=port)
     return mgr
 
 
@@ -299,17 +299,10 @@ def test_replica_recovers_after_restart_on_same_port():
     from tests.conftest import free_port
     port_b = free_port()
 
-    def serve_on(port):
-        mgr = tpulab.InferenceManager(max_exec_concurrency=1, max_buffers=4)
-        mgr.register_model("mnist", make_mnist(max_batch_size=2))
-        mgr.update_resources()
-        mgr.serve(port=port)
-        return mgr
-
     mgr_a = mgr_b = rs = None
     try:
         mgr_a = _serve_mnist()
-        mgr_b = serve_on(port_b)
+        mgr_b = _serve_mnist(port=port_b)
         addrs = [f"127.0.0.1:{mgr_a.server.bound_port}",
                  f"127.0.0.1:{port_b}"]
         rs = ReplicaSet(addrs, "mnist")
@@ -319,7 +312,7 @@ def test_replica_recovers_after_restart_on_same_port():
         for _ in range(4):
             rs.infer(Input3=X).result(timeout=60)  # ...failover carries on
         assert not rs.health()[addrs[1]]["live"]
-        mgr_b = serve_on(port_b)  # ...and comes back on the same port
+        mgr_b = _serve_mnist(port=port_b)  # back on the same port
         import time
         deadline = time.time() + 30
         while time.time() < deadline:
