@@ -334,3 +334,46 @@ def test_replica_recovers_after_restart_on_same_port():
                     m.shutdown()
             except Exception:
                 pass
+
+
+def test_generation_prefix_affinity_routing():
+    """Prefix-cache-aware routing: same prompt prefix -> same replica
+    (cache stays warm); different prefixes spread; overload and failover
+    break the affinity rather than hotspotting or stranding requests."""
+    from tpulab.rpc.replica import GenerationReplicaSet
+    mgr_a, eng = _serve_lm()
+    mgr_b, _ = _serve_lm()
+    grs = None
+    try:
+        addrs = [f"127.0.0.1:{m.server.bound_port}" for m in (mgr_a, mgr_b)]
+        grs = GenerationReplicaSet(addrs, "lm", prefix_affinity=True,
+                                   affinity_tokens=4, affinity_slack=1)
+        p1 = np.arange(6, dtype=np.int32)
+        expected = list(eng.generate(p1[None, :], 5)[0])
+        home = grs._preferred(list(p1))
+        for _ in range(4):  # repeats stay home — the cache-warmth contract
+            assert list(grs.generate(p1, 5)) == expected
+        assert grs.served[home] == 4 and grs.served[1 - home] == 0
+        # a prompt differing INSIDE the affinity window may hash elsewhere;
+        # one differing only BEYOND it keeps the same home
+        p_same = np.concatenate([p1[:4], [9, 9]]).astype(np.int32)
+        assert grs._preferred(list(p_same)) == home
+        # overloaded home: simulate inflight pressure, pick falls back
+        grs._inflight[home] += 3  # beyond slack
+        try:
+            assert grs._pick_affine(list(p1), frozenset()) == 1 - home
+            grs._inflight[1 - home] -= 1  # undo pick's increment
+        finally:
+            grs._inflight[home] -= 3
+        # dead home: failover still completes the stream elsewhere
+        (mgr_a, mgr_b)[home].server.shutdown(grace_s=0.0)
+        assert list(grs.generate(p1, 5)) == expected
+        assert grs.served[1 - home] >= 1
+    finally:
+        if grs is not None:
+            grs.close()
+        for m in (mgr_a, mgr_b):
+            try:
+                m.shutdown()
+            except Exception:
+                pass

@@ -79,20 +79,26 @@ class _BaseReplicaSet:
         return out
 
     # -- dispatch -----------------------------------------------------------
+    def _pick_locked(self, exclude: frozenset) -> Optional[int]:
+        """Least-loaded with round-robin tie-breaking (sequential traffic
+        rotates instead of piling onto index 0 — envoy's round-robin
+        behavior at the tie).  CALLER HOLDS self._lock; does NOT bump
+        inflight — the single shared selection algorithm."""
+        candidates = [(n, i) for i, n in enumerate(self._inflight)
+                      if i not in exclude]
+        if not candidates:
+            return None
+        lo = min(n for n, _ in candidates)
+        tied = [i for n, i in candidates if n == lo]
+        idx = tied[self._rr % len(tied)]
+        self._rr += 1
+        return idx
+
     def _pick(self, exclude: frozenset) -> Optional[int]:
-        """Least-loaded with round-robin tie-breaking: sequential (zero-
-        inflight) traffic rotates across replicas instead of piling onto
-        index 0 (envoy's round-robin behavior at the tie)."""
         with self._lock:
-            candidates = [(n, i) for i, n in enumerate(self._inflight)
-                          if i not in exclude]
-            if not candidates:
-                return None
-            lo = min(n for n, _ in candidates)
-            tied = [i for n, i in candidates if n == lo]
-            idx = tied[self._rr % len(tied)]
-            self._rr += 1
-            self._inflight[idx] += 1
+            idx = self._pick_locked(exclude)
+            if idx is not None:
+                self._inflight[idx] += 1
             return idx
 
     def _pick_or_any(self, exclude: frozenset) -> Optional[int]:
@@ -186,13 +192,57 @@ class ReplicaSet(_BaseReplicaSet):
 
 class GenerationReplicaSet(_BaseReplicaSet):
     """Least-loaded routing + exactly-once replay failover for
-    token-streaming generation (module docstring: determinism contract)."""
+    token-streaming generation (module docstring: determinism contract).
+
+    ``prefix_affinity=True`` adds prefix-cache-aware routing: requests
+    whose prompts share their first ``affinity_tokens`` tokens hash to
+    the same preferred replica, so a replica's ref-counted prefix cache
+    (engine/paged.py PrefixCache) keeps serving the prompts it has
+    already prefilled — the cross-replica analog of the in-engine cache.
+    Affinity is a PREFERENCE, not a pin: when the preferred replica
+    carries more than ``affinity_slack`` requests above the least-loaded
+    one (or is excluded by failover), routing falls back to least-loaded
+    — cache warmth must never become a hotspot or a single point of
+    failure."""
 
     def __init__(self, addresses: Sequence[str], model_name: str,
-                 channels: int = 1, max_failover: Optional[int] = None):
+                 channels: int = 1, max_failover: Optional[int] = None,
+                 prefix_affinity: bool = False, affinity_tokens: int = 32,
+                 affinity_slack: int = 2):
         super().__init__(addresses, model_name, channels, max_failover)
         self._clients = [GenerateStreamClient(m, model_name)
                         for m in self._managers]
+        self.prefix_affinity = prefix_affinity
+        self.affinity_tokens = affinity_tokens
+        self.affinity_slack = affinity_slack
+
+    def _preferred(self, prompt) -> int:
+        """Stable prefix-hash home for a prompt (same first
+        ``affinity_tokens`` tokens -> same replica)."""
+        import hashlib
+        prefix = b",".join(b"%d" % int(t)
+                           for t in prompt[:self.affinity_tokens])
+        digest = hashlib.blake2s(prefix, digest_size=4).digest()
+        return int.from_bytes(digest, "little") % len(self._managers)
+
+    def _pick_affine(self, prompt, exclude: frozenset) -> Optional[int]:
+        """The pref short-circuit over the shared selection algorithm;
+        mirrors _pick_or_any's all-excluded fallback (retry anyone)."""
+        pref = self._preferred(prompt)
+        with self._lock:
+            loads = [n for i, n in enumerate(self._inflight)
+                     if i not in exclude]
+            if not loads:  # every replica already failed this request
+                idx = self._pick_locked(frozenset())
+            elif (pref not in exclude
+                    and self._inflight[pref] <= min(loads)
+                    + self.affinity_slack):
+                idx = pref
+            else:  # overloaded/dead home: shared least-loaded policy
+                idx = self._pick_locked(exclude)
+            if idx is not None:
+                self._inflight[idx] += 1
+            return idx
 
     def generate(self, prompt, steps: int, timeout: float = 300.0, **kw):
         """Token iterator with transparent failover.
@@ -214,7 +264,10 @@ class GenerationReplicaSet(_BaseReplicaSet):
         attempts_left = self._max_failover
         exclude: set = set()
         while True:
-            idx = self._pick_or_any(frozenset(exclude))
+            if self.prefix_affinity:
+                idx = self._pick_affine(prompt, frozenset(exclude))
+            else:
+                idx = self._pick_or_any(frozenset(exclude))
             if idx is None:
                 raise RuntimeError("no replicas")
             gen = None
