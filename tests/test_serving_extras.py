@@ -601,3 +601,80 @@ def test_speculative_equals_target_greedy():
     got2 = g_mix.generate(prompt, steps)
     assert got2 == want, (got2, want)
     assert g_mix.rounds >= g_self.rounds  # worse draft -> more rounds
+
+
+def test_speculative_served_through_generate_rpc():
+    """SpeculativeSessionEngine plugs speculation into the serving path:
+    tokens stream over the Generate RPC in verified bursts and equal the
+    target model's vanilla greedy sequence; sampling is rejected (the
+    dense-path greedy-only contract)."""
+    import jax.numpy as jnp
+
+    from tpulab.engine.speculative import (SpeculativeGenerator,
+                                           SpeculativeSessionEngine)
+    from tpulab.models.transformer import (init_transformer_params,
+                                           make_generate_fn)
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          GenerationRejected,
+                                          RemoteInferenceManager)
+
+    kw = dict(n_kv_heads=2, rope_theta=10000.0)
+    target = init_transformer_params(vocab=64, d_model=64, n_heads=4,
+                                     n_layers=2, d_ff=96, n_kv_heads=2,
+                                     ffn="swiglu", seed=0)
+    dense = make_generate_fn(target, n_heads=4, n_layers=2, max_len=96,
+                             compute_dtype=jnp.float32, **kw)
+    spec = SpeculativeGenerator(target, target, n_heads=4, n_layers=2,
+                                k=3, max_len=96, compute_dtype=jnp.float32,
+                                **kw)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={
+        "lm-spec": SpeculativeSessionEngine(spec, max_sessions=1)})
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        prompt = np.random.default_rng(0).integers(0, 64, (6,), np.int32)
+        steps = 12
+        want = list(np.asarray(dense(prompt[None, :], steps)[0]))
+        client = GenerateStreamClient(remote, "lm-spec")
+        got = list(client.generate(prompt, steps))
+        assert got == want, (got, want)
+        assert spec.rounds > 0 and spec.accepted == spec.rounds * 3
+        with pytest.raises(GenerationRejected, match="dense session"):
+            list(client.generate(prompt, 4, temperature=0.7))
+    finally:
+        remote.close()
+        mgr.shutdown()
+
+
+def test_speculative_session_contract():
+    """Session shape parity with the dense engine: direct use + close(),
+    context-manager use, admission release on both, use-after-close
+    rejection, and the exactly-steps contract at steps=0."""
+    import jax.numpy as jnp
+
+    from tpulab.engine.speculative import (SpeculativeGenerator,
+                                           SpeculativeSessionEngine)
+    from tpulab.models.transformer import init_transformer_params
+
+    params = init_transformer_params(vocab=32, d_model=32, n_heads=2,
+                                     n_layers=1, d_ff=48, seed=0)
+    spec = SpeculativeGenerator(params, params, n_heads=2, n_layers=1,
+                                k=2, max_len=64, compute_dtype=jnp.float32)
+    assert spec.generate([1, 2, 3], 0) == []  # steps=0 -> no tokens
+    eng = SpeculativeSessionEngine(spec, max_sessions=1)
+    # direct (non-with) use must release the slot via close()
+    s = eng.start_session(timeout=5)
+    s.prefill([1, 2, 3])
+    toks = list(s.stream(4))
+    assert len(toks) == 4
+    s.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        s.prefill([1])
+    # the slot is free again: context-manager use works immediately
+    with eng.start_session(timeout=5) as s2:
+        s2.prefill([1, 2, 3])
+        assert list(s2.stream(4)) == toks  # deterministic greedy
+    with eng.start_session(timeout=5):
+        pass  # released by the with-exit above, not leaked

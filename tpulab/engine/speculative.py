@@ -101,16 +101,24 @@ class SpeculativeGenerator:
         self._propose = draft_propose
 
     # -- public --------------------------------------------------------------
-    def generate(self, prompt, steps: int) -> List[int]:
-        """Greedy-decode ``steps`` tokens; returns exactly the target
-        model's greedy continuation.  ``rounds``/``accepted`` telemetry
-        from the last call is exposed on the instance."""
+    def stream(self, prompt, steps: int):
+        """Yield exactly ``steps`` greedy tokens as they are VERIFIED —
+        one burst per speculation round (accepted prefix + correction).
+        Tokens never stream before the target has verified them, so a
+        consumer sees the same exactly-greedy sequence ``generate``
+        returns, with burst granularity.  Each call owns fresh KV caches
+        (concurrent streams on one instance are safe; the jitted
+        programs are shared).  ``rounds``/``accepted`` telemetry from the
+        last finished call is exposed on the instance."""
         jnp = self._jnp
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         t_p = prompt.shape[0]
         if max(t_p + steps + self.k + 1,
                1 << (t_p - 1).bit_length()) > self.max_len:
             raise ValueError("prompt+steps+k exceeds max_len")
+        if steps <= 0:  # exactly-steps contract holds at zero too
+            self.rounds = self.accepted = 0
+            return
 
         t_cache, d_cache = self._t_cache(), self._d_cache()
         # prefill both models with one chunked forward each (pow2 bucket)
@@ -122,11 +130,11 @@ class SpeculativeGenerator:
         _, d_cache = self._d_prefill(self.draft_params, d_cache,
                                      jnp.asarray(padded), jnp.int32(0))
         cur = int(np.asarray(tl)[0, t_p - 1].argmax())
-        out = [cur]
+        emitted_n = 1
+        yield cur
         p = t_p                     # tokens FED to the target so far
-        self.rounds = 0
-        self.accepted = 0
-        while len(out) < steps:
+        rounds = accepted = 0
+        while emitted_n < steps:
             drafts, d_cache = self._propose(
                 self.draft_params, d_cache,
                 jnp.asarray([cur], jnp.int32), jnp.int32(p))
@@ -141,10 +149,91 @@ class SpeculativeGenerator:
             a = 0
             while a < self.k and drafts[a] == greedy[a]:
                 a += 1
-            emitted = list(drafts[:a]) + [int(greedy[a])]
-            out.extend(emitted)
             cur = int(greedy[a])
             p += a + 1
-            self.rounds += 1
-            self.accepted += a
-        return out[:steps]
+            rounds += 1
+            accepted += a
+            for tok in list(drafts[:a]) + [cur]:
+                if emitted_n < steps:
+                    emitted_n += 1
+                    yield int(tok)
+        self.rounds = rounds
+        self.accepted = accepted
+
+    def generate(self, prompt, steps: int) -> List[int]:
+        """Greedy-decode ``steps`` tokens; returns exactly the target
+        model's greedy continuation (see :meth:`stream`)."""
+        return list(self.stream(prompt, steps))
+
+
+class _SpeculativeSession:
+    """One admitted decode: usable directly (``close()``) or as a context
+    manager, mirroring the dense :class:`GenerationSession` shape.  The
+    semaphore slot releases exactly once — on close/exit or, as a last
+    resort, at GC, so an abandoned session cannot deadlock admission."""
+
+    def __init__(self, spec: SpeculativeGenerator, sem):
+        self._spec = spec
+        self._sem = sem
+        self._prompt: Optional[np.ndarray] = None
+        self._closed = False
+
+    def prefill(self, prompt) -> None:
+        if self._closed:
+            raise RuntimeError("session is closed")
+        self._prompt = np.asarray(prompt, np.int32).reshape(-1)
+
+    def stream(self, steps: int):
+        if self._closed:
+            raise RuntimeError("session is closed")
+        if self._prompt is None:
+            raise RuntimeError("prefill() before stream()")
+        return self._spec.stream(self._prompt, steps)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._sem.release()
+
+    def __enter__(self) -> "_SpeculativeSession":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    def __del__(self):  # GC fallback; close() is idempotent
+        self.close()
+
+
+class SpeculativeSessionEngine:
+    """Serving adapter: a :class:`SpeculativeGenerator` behind the
+    Generate RPC's dense-session interface (``start_session`` ->
+    ``prefill``/``stream``), so speculative decoding plugs into
+    ``manager.serve(generation_engines={...})`` like any engine.
+
+    Tokens stream in verified bursts (one per speculation round); the
+    wire sequence is exactly the target model's greedy output.  Sessions
+    are admission tokens (``max_sessions`` bounds concurrent decodes —
+    the generator itself is stateless per call); sampling requests are
+    rejected upstream by the dense-path greedy-only check."""
+
+    def __init__(self, spec: SpeculativeGenerator, max_sessions: int = 2):
+        import threading
+        self._spec = spec
+        self._sem = threading.BoundedSemaphore(max_sessions)
+
+    #: telemetry passthrough (last finished call)
+    @property
+    def rounds(self):
+        return getattr(self._spec, "rounds", 0)
+
+    @property
+    def accepted(self):
+        return getattr(self._spec, "accepted", 0)
+
+    def start_session(self, timeout: Optional[float] = None
+                      ) -> _SpeculativeSession:
+        if not self._sem.acquire(timeout=timeout):
+            raise TimeoutError("no speculative session available")
+        return _SpeculativeSession(self._spec, self._sem)
