@@ -43,12 +43,18 @@ def main():
     ap.add_argument("--lanes", type=int, default=8)
     ap.add_argument("--int8", action="store_true",
                     help="weight-only INT8 (W8A16)")
+    ap.add_argument("--speculative", type=int, default=0, metavar="K",
+                    help="also serve greedy speculative decoding as model "
+                         "'llm-spec' (K drafts/round; random-init demo "
+                         "drafts with the target itself)")
     ap.add_argument("--kv-fp8", action="store_true",
                     help="fp8 e4m3 KV pages")
     ap.add_argument("--rope-theta", type=float, default=10000.0,
                     help="RoPE base (MUST match the checkpoint's config, "
                          "e.g. 500000 for Llama-3-class models)")
     # client-mode options
+    ap.add_argument("--model", default="llm",
+                    help="generation model name (llm | llm-spec)")
     ap.add_argument("--prompt", default="1,2,3,4")
     ap.add_argument("--steps", type=int, default=16)
     ap.add_argument("--temperature", type=float, default=0.0)
@@ -79,7 +85,7 @@ def main():
             # generation analog of examples/99's scale-out
             from tpulab.rpc.replica import GenerationReplicaSet
             addrs = [a.strip() for a in args.connect.split(",") if a.strip()]
-            grs = GenerationReplicaSet(addrs, "llm")
+            grs = GenerationReplicaSet(addrs, args.model)
             try:
                 for tok in grs.generate(prompt, args.steps, **kw):
                     print(tok, end=" ", flush=True)
@@ -91,7 +97,7 @@ def main():
         from tpulab.rpc.infer_service import (GenerateStreamClient,
                                               RemoteInferenceManager)
         remote = RemoteInferenceManager(args.connect)
-        client = GenerateStreamClient(remote, "llm")
+        client = GenerateStreamClient(remote, args.model)
         for tok in client.generate(prompt, args.steps, **kw):
             print(tok, end=" ", flush=True)
         print("\ndone")
@@ -133,9 +139,22 @@ def main():
         prefix_cache=True, prefill_chunk=256,
         kv_dtype=jnp.float8_e4m3fn if args.kv_fp8 else None)
 
+    engines = {"llm": cb}
+    if args.speculative > 0:
+        # target drafts for itself in this random-init demo (full
+        # acceptance); with a real checkpoint pass a distilled draft to
+        # SpeculativeGenerator instead
+        from tpulab.engine.speculative import (SpeculativeGenerator,
+                                               SpeculativeSessionEngine)
+        spec = SpeculativeGenerator(
+            params, params, n_heads=heads, n_layers=layers,
+            n_kv_heads=kv_heads, k=args.speculative, max_len=args.max_len,
+            compute_dtype=jnp.float32, rope_theta=rope_theta)
+        engines["llm-spec"] = SpeculativeSessionEngine(spec, max_sessions=2)
+
     # generation-only deployment: no dense models, just the Generate RPC
     mgr = tpulab.InferenceManager(max_exec_concurrency=1)
-    mgr.serve(port=args.port, generation_engines={"llm": cb})
+    mgr.serve(port=args.port, generation_engines=engines)
     print(f"LLM server on :{mgr.server.bound_port} "
           f"(lanes={args.lanes} max_len={args.max_len} "
           f"int8={args.int8} kv_fp8={args.kv_fp8} "
@@ -145,8 +164,13 @@ def main():
     try:
         if args.oneshot:
             # completed_requests is edge-proof (a fast generation can start
-            # AND finish between active_lanes polls)
-            while cb.completed_requests == 0:
+            # AND finish between active_lanes polls); either engine
+            # finishing a request satisfies oneshot
+            def _completed():
+                return (cb.completed_requests
+                        + sum(getattr(e, "completed_requests", 0)
+                              for e in engines.values() if e is not cb))
+            while _completed() == 0:
                 time.sleep(0.1)
             time.sleep(2.0)  # let the final stream frames flush
         else:

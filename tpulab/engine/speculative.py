@@ -172,10 +172,12 @@ class _SpeculativeSession:
     semaphore slot releases exactly once — on close/exit or, as a last
     resort, at GC, so an abandoned session cannot deadlock admission."""
 
-    def __init__(self, spec: SpeculativeGenerator, sem):
+    def __init__(self, spec: SpeculativeGenerator, sem, on_close=None):
         self._spec = spec
         self._sem = sem
+        self._on_close = on_close
         self._prompt: Optional[np.ndarray] = None
+        self._streamed = False
         self._closed = False
 
     def prefill(self, prompt) -> None:
@@ -188,12 +190,15 @@ class _SpeculativeSession:
             raise RuntimeError("session is closed")
         if self._prompt is None:
             raise RuntimeError("prefill() before stream()")
+        self._streamed = True
         return self._spec.stream(self._prompt, steps)
 
     def close(self) -> None:
         if not self._closed:
             self._closed = True
             self._sem.release()
+            if self._streamed and self._on_close is not None:
+                self._on_close()
 
     def __enter__(self) -> "_SpeculativeSession":
         return self
@@ -222,6 +227,14 @@ class SpeculativeSessionEngine:
         import threading
         self._spec = spec
         self._sem = threading.BoundedSemaphore(max_sessions)
+        self._count_lock = threading.Lock()
+        #: sessions that streamed and closed (oneshot/ops accounting,
+        #: mirroring ContinuousBatcher.completed_requests)
+        self.completed_requests = 0
+
+    def _count_completion(self) -> None:
+        with self._count_lock:
+            self.completed_requests += 1
 
     #: telemetry passthrough (last finished call)
     @property
@@ -236,4 +249,5 @@ class SpeculativeSessionEngine:
                       ) -> _SpeculativeSession:
         if not self._sem.acquire(timeout=timeout):
             raise TimeoutError("no speculative session available")
-        return _SpeculativeSession(self._spec, self._sem)
+        return _SpeculativeSession(self._spec, self._sem,
+                                   on_close=self._count_completion)
