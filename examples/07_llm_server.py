@@ -167,9 +167,8 @@ def main():
             # AND finish between active_lanes polls); either engine
             # finishing a request satisfies oneshot
             def _completed():
-                return (cb.completed_requests
-                        + sum(getattr(e, "completed_requests", 0)
-                              for e in engines.values() if e is not cb))
+                return sum(getattr(e, "completed_requests", 0)
+                           for e in engines.values())
             while _completed() == 0:
                 time.sleep(0.1)
             time.sleep(2.0)  # let the final stream frames flush

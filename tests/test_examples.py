@@ -384,3 +384,19 @@ def test_07_llm_server_replicated_client():
     finally:
         for srv in srvs:
             srv.kill()
+
+
+def test_notebook_scale_out_serving():
+    """The scale-out tour runs end to end (replica routing, failover,
+    affinity, exactly-once stream replay — assertions inside)."""
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "TPULAB_FORCE_CPU": "1", "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from tpulab.tpu.platform import force_cpu; force_cpu(1);"
+         "import runpy; runpy.run_path("
+         f"'{REPO}/notebooks/scale_out_serving.py', run_name='__main__')"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "scale-out serving tour complete" in out.stdout

@@ -678,3 +678,37 @@ def test_speculative_session_contract():
         assert list(s2.stream(4)) == toks  # deterministic greedy
     with eng.start_session(timeout=5):
         pass  # released by the with-exit above, not leaked
+
+
+def test_speculative_completion_accounting():
+    """completed_requests mirrors the batcher's success-only semantics:
+    exhausted and stop-token-broken streams count; errored ones don't."""
+    import jax.numpy as jnp
+
+    from tpulab.engine.speculative import (SpeculativeGenerator,
+                                           SpeculativeSessionEngine)
+    from tpulab.models.transformer import init_transformer_params
+
+    params = init_transformer_params(vocab=32, d_model=32, n_heads=2,
+                                     n_layers=1, d_ff=48, seed=0)
+    spec = SpeculativeGenerator(params, params, n_heads=2, n_layers=1,
+                                k=2, max_len=32, compute_dtype=jnp.float32)
+    eng = SpeculativeSessionEngine(spec, max_sessions=1)
+    # exhausted stream -> counts
+    with eng.start_session(timeout=5) as s:
+        s.prefill([1, 2, 3])
+        assert len(list(s.stream(4))) == 4
+    assert eng.completed_requests == 1
+    # early break after served tokens (the stop-token path) -> counts
+    with eng.start_session(timeout=5) as s:
+        s.prefill([1, 2, 3])
+        it = s.stream(6)
+        next(it)
+        it.close()
+    assert eng.completed_requests == 2
+    # error before any token (prompt+steps+k+1 > max_len) -> no count
+    with eng.start_session(timeout=5) as s:
+        s.prefill([1, 2, 3])
+        with pytest.raises(ValueError, match="max_len"):
+            next(s.stream(30))
+    assert eng.completed_requests == 2

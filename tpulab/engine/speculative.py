@@ -177,7 +177,7 @@ class _SpeculativeSession:
         self._sem = sem
         self._on_close = on_close
         self._prompt: Optional[np.ndarray] = None
-        self._streamed = False
+        self._completed = False
         self._closed = False
 
     def prefill(self, prompt) -> None:
@@ -190,14 +190,32 @@ class _SpeculativeSession:
             raise RuntimeError("session is closed")
         if self._prompt is None:
             raise RuntimeError("prefill() before stream()")
-        self._streamed = True
-        return self._spec.stream(self._prompt, steps)
+        inner = self._spec.stream(self._prompt, steps)
+
+        def counted():
+            # a session completes when its stream is EXHAUSTED, or when
+            # the consumer closes it early after >=1 served token (the
+            # stop-token break path).  Errors leave it un-completed —
+            # mirrors ContinuousBatcher.completed_requests (success-only)
+            served = 0
+            try:
+                for tok in inner:
+                    served += 1
+                    yield tok
+            except GeneratorExit:
+                if served > 0:
+                    self._completed = True
+                raise
+            else:
+                self._completed = True
+
+        return counted()
 
     def close(self) -> None:
         if not self._closed:
             self._closed = True
             self._sem.release()
-            if self._streamed and self._on_close is not None:
+            if self._completed and self._on_close is not None:
                 self._on_close()
 
     def __enter__(self) -> "_SpeculativeSession":
