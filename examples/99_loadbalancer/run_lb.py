@@ -141,6 +141,11 @@ def main() -> int:
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line instead of the table")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="expose the ReplicaSet's routing metrics "
+                         "(tpulab_replica_*) on this /metrics port — the "
+                         "client-side series the deploy dashboard's "
+                         "replica panels read")
     args = ap.parse_args()
 
     sys.path.insert(0, REPO)
@@ -158,7 +163,14 @@ def main() -> int:
                                   args.n, args.depth)
         remote.close()
 
-        rs = ReplicaSet([f"127.0.0.1:{p}" for p in ports], "mnist")
+        rs_metrics = None
+        if args.metrics_port:
+            from tpulab.utils.metrics import (ReplicaSetMetrics,
+                                              start_metrics_server)
+            rs_metrics = ReplicaSetMetrics()
+            start_metrics_server(rs_metrics, port=args.metrics_port)
+        rs = ReplicaSet([f"127.0.0.1:{p}" for p in ports], "mnist",
+                        metrics=rs_metrics)
         results["replicaset"] = siege(lambda x: rs.infer(Input3=x),
                                       args.n, args.depth)
         results["replicaset"]["split"] = list(rs.served)

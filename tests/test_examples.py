@@ -123,13 +123,19 @@ def test_grafana_dashboard_matches_exported_metrics():
     wanted = set()
     for e in exprs:
         wanted.update(re.findall(r"(tpulab_[a-z0-9_]+)", e))
-    from tpulab.utils.metrics import InferenceMetrics
+    from tpulab.utils.metrics import InferenceMetrics, ReplicaSetMetrics
     m = InferenceMetrics()
     m.observe_request(0.01, 0.005)  # populate histogram child series
+    rm = ReplicaSetMetrics()
+    rm.requests.labels(replica="x").inc()  # populate labeled children
+    rm.inflight.labels(replica="x").set(0)
+    rm.live.labels(replica="x").set(1)
+    rm.failovers.inc()
     exported = set()
-    for metric in m.registry.collect():
-        for s in metric.samples:
-            exported.add(s.name)
+    for reg in (m.registry, rm.registry):
+        for metric in reg.collect():
+            for s in metric.samples:
+                exported.add(s.name)
     missing = {w for w in wanted
                if w not in exported and w.removesuffix("_bucket") + "_bucket"
                not in exported}

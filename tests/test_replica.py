@@ -377,3 +377,47 @@ def test_generation_prefix_affinity_routing():
                 m.shutdown()
             except Exception:
                 pass
+
+
+def test_replicaset_metrics_export():
+    """ReplicaSetMetrics: per-replica traffic/inflight/live + failovers
+    reach the registry through routing, failover, and health probes."""
+    from prometheus_client import CollectorRegistry
+
+    from tpulab.utils.metrics import ReplicaSetMetrics
+    mgr_a, mgr_b = _serve_mnist(), _serve_mnist()
+    rs = None
+    try:
+        addrs = [f"127.0.0.1:{m.server.bound_port}" for m in (mgr_a, mgr_b)]
+        metrics = ReplicaSetMetrics(registry=CollectorRegistry())
+        rs = ReplicaSet(addrs, "mnist", metrics=metrics)
+        for _ in range(6):
+            rs.infer(Input3=X).result(timeout=60)
+        rs.health()
+
+        def sample(name, labels=None):
+            return metrics.registry.get_sample_value(name, labels or {})
+
+        total = sum(sample("tpulab_replica_requests_total",
+                           {"replica": a}) or 0 for a in addrs)
+        assert total == 6
+        assert all(sample("tpulab_replica_inflight", {"replica": a}) == 0
+                   for a in addrs)
+        assert all(sample("tpulab_replica_live", {"replica": a}) == 1
+                   for a in addrs)
+        assert sample("tpulab_replica_failovers_total") == 0
+        # kill one: failovers count, liveness drops
+        mgr_b.shutdown()
+        for _ in range(3):
+            rs.infer(Input3=X).result(timeout=60)
+        rs.health()
+        assert sample("tpulab_replica_live", {"replica": addrs[1]}) == 0
+        assert (sample("tpulab_replica_failovers_total") or 0) >= 1
+    finally:
+        if rs is not None:
+            rs.close()
+        for m in (mgr_a, mgr_b):
+            try:
+                m.shutdown()
+            except Exception:
+                pass

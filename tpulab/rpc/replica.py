@@ -41,7 +41,8 @@ class _BaseReplicaSet:
     tie-breaking, per-replica health, inflight/served accounting."""
 
     def __init__(self, addresses: Sequence[str], model_name: str,
-                 channels: int = 1, max_failover: Optional[int] = None):
+                 channels: int = 1, max_failover: Optional[int] = None,
+                 metrics=None):
         if not addresses:
             raise ValueError("need at least one replica address")
         self.addresses = list(addresses)
@@ -55,6 +56,32 @@ class _BaseReplicaSet:
         self._rr = 0  # tie-break rotation cursor
         self._max_failover = (len(self._managers) if max_failover is None
                               else max_failover)
+        #: optional :class:`tpulab.utils.metrics.ReplicaSetMetrics`
+        self._metrics = metrics
+        if metrics is not None:
+            # label children resolved ONCE: .labels() takes the metric's
+            # lock + hashes the tuple, too heavy for inside the routing
+            # critical section on every pick/completion
+            self._m_inflight = [metrics.inflight.labels(replica=a)
+                                for a in self.addresses]
+            self._m_requests = [metrics.requests.labels(replica=a)
+                                for a in self.addresses]
+            self._m_live = [metrics.live.labels(replica=a)
+                            for a in self.addresses]
+
+    # -- metrics hooks (no-ops without a metrics object) --------------------
+    def _note_inflight(self, idx: int) -> None:
+        """CALLER HOLDS self._lock."""
+        if self._metrics is not None:
+            self._m_inflight[idx].set(self._inflight[idx])
+
+    def _note_served(self, idx: int) -> None:
+        if self._metrics is not None:
+            self._m_requests[idx].inc()
+
+    def _note_failover(self) -> None:
+        if self._metrics is not None:
+            self._metrics.failovers.inc()
 
     # -- health -------------------------------------------------------------
     def health(self, timeout: float = 10.0) -> Dict[str, dict]:
@@ -76,6 +103,10 @@ class _BaseReplicaSet:
             except Exception as e:  # noqa: BLE001 - dead replica is data
                 out[addr] = {"live": False, "ready": False,
                              "error": f"{type(e).__name__}: {e}"}
+        if self._metrics is not None:
+            for i, addr in enumerate(self.addresses):
+                if addr in out:
+                    self._m_live[i].set(1 if out[addr]["live"] else 0)
         return out
 
     # -- dispatch -----------------------------------------------------------
@@ -99,6 +130,7 @@ class _BaseReplicaSet:
             idx = self._pick_locked(exclude)
             if idx is not None:
                 self._inflight[idx] += 1
+                self._note_inflight(idx)
             return idx
 
     def _pick_or_any(self, exclude: frozenset) -> Optional[int]:
@@ -124,8 +156,10 @@ class ReplicaSet(_BaseReplicaSet):
     """Least-loaded router with failover over remote unary replicas."""
 
     def __init__(self, addresses: Sequence[str], model_name: str,
-                 channels: int = 1, max_failover: Optional[int] = None):
-        super().__init__(addresses, model_name, channels, max_failover)
+                 channels: int = 1, max_failover: Optional[int] = None,
+                 metrics=None):
+        super().__init__(addresses, model_name, channels, max_failover,
+                         metrics=metrics)
         # runners are built LAZILY per replica: constructing one performs a
         # blocking Status RPC, and a replica that is down at construction
         # (rolling restart) must count as a failed submission on that
@@ -164,14 +198,17 @@ class ReplicaSet(_BaseReplicaSet):
         def on_done(fut: Future) -> None:
             with self._lock:
                 self._inflight[idx] -= 1
+                self._note_inflight(idx)
             exc = fut.exception()
             if exc is None:
                 with self._lock:
                     self.served[idx] += 1
+                self._note_served(idx)
                 if not outer.done():
                     outer.set_result(fut.result())
                 return
             if attempts_left > 1 and not outer.done():
+                self._note_failover()
                 self._submit(outer, arrays, attempts_left - 1,
                              exclude | {idx})
             elif not outer.done():
@@ -183,7 +220,9 @@ class ReplicaSet(_BaseReplicaSet):
             #                     or unreachable at first contact)
             with self._lock:
                 self._inflight[idx] -= 1
+                self._note_inflight(idx)
             if attempts_left > 1:
+                self._note_failover()
                 self._submit(outer, arrays, attempts_left - 1,
                              exclude | {idx})
             else:
@@ -208,8 +247,9 @@ class GenerationReplicaSet(_BaseReplicaSet):
     def __init__(self, addresses: Sequence[str], model_name: str,
                  channels: int = 1, max_failover: Optional[int] = None,
                  prefix_affinity: bool = False, affinity_tokens: int = 32,
-                 affinity_slack: int = 2):
-        super().__init__(addresses, model_name, channels, max_failover)
+                 affinity_slack: int = 2, metrics=None):
+        super().__init__(addresses, model_name, channels, max_failover,
+                         metrics=metrics)
         self._clients = [GenerateStreamClient(m, model_name)
                         for m in self._managers]
         self.prefix_affinity = prefix_affinity
@@ -242,6 +282,7 @@ class GenerationReplicaSet(_BaseReplicaSet):
                 idx = self._pick_locked(exclude)
             if idx is not None:
                 self._inflight[idx] += 1
+                self._note_inflight(idx)
             return idx
 
     def generate(self, prompt, steps: int, timeout: float = 300.0, **kw):
@@ -282,6 +323,7 @@ class GenerationReplicaSet(_BaseReplicaSet):
                     i += 1
                 with self._lock:
                     self.served[idx] += 1
+                self._note_served(idx)
                 return
             except Exception as e:
                 from tpulab.rpc.infer_service import GenerationRejected
@@ -293,8 +335,10 @@ class GenerationReplicaSet(_BaseReplicaSet):
                 exclude.add(idx)
                 if attempts_left <= 0:
                     raise
+                self._note_failover()
             finally:
                 with self._lock:
                     self._inflight[idx] -= 1
+                    self._note_inflight(idx)
                 if gen is not None:
                     gen.close()  # abandoned inner stream cancels promptly

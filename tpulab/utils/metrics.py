@@ -135,6 +135,35 @@ class InferenceMetrics:
         self.refresh_quantiles()  # scrape-freshness without hot-path sorts
 
 
+class ReplicaSetMetrics:
+    """Observability for client-side replica routing
+    (:mod:`tpulab.rpc.replica`): per-replica traffic/inflight/liveness and
+    the failover counter — the client-side view envoy's upstream stats
+    give in deployment."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.requests = Counter(
+            f"{ns}_replica_requests_total",
+            "Requests completed per replica", ["replica"],
+            registry=self.registry)
+        self.failovers = Counter(
+            f"{ns}_replica_failovers_total",
+            "Requests re-routed off a failed replica",
+            registry=self.registry)
+        self.inflight = Gauge(
+            f"{ns}_replica_inflight", "In-flight requests per replica",
+            ["replica"], registry=self.registry)
+        self.live = Gauge(
+            f"{ns}_replica_live",
+            "Last health-probe liveness per replica (1/0)", ["replica"],
+            registry=self.registry)
+
+
 def start_metrics_server(metrics: InferenceMetrics, port: int = 9090):
     """Expose /metrics (reference Exposer on :8080)."""
     return start_http_server(port, registry=metrics.registry)
