@@ -171,8 +171,10 @@ def main() -> int:
             start_metrics_server(rs_metrics, port=args.metrics_port)
         rs = ReplicaSet([f"127.0.0.1:{p}" for p in ports], "mnist",
                         metrics=rs_metrics)
+        rs.health()  # seeds the per-replica liveness series
         results["replicaset"] = siege(lambda x: rs.infer(Input3=x),
                                       args.n, args.depth)
+        rs.health()  # refresh liveness after the siege
         results["replicaset"]["split"] = list(rs.served)
         rs.close()
 

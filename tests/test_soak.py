@@ -84,3 +84,73 @@ def test_mixed_load_soak():
         remote.close()
         mgr.shutdown()
         cb.shutdown()
+
+
+def test_generation_replica_soak_with_kill():
+    """Concurrency soak on GenerationReplicaSet: many threads stream with
+    prefix affinity while a replica is crashed and restarted mid-soak —
+    every stream must complete with the exact greedy sequence, inflight
+    must return to zero, and no thread may hang."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import tpulab
+    from tests.conftest import free_port
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.mnist import make_mnist
+    from tpulab.models.transformer import init_transformer_params
+    from tpulab.rpc.replica import GenerationReplicaSet
+
+    params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                     n_layers=2, d_ff=64)
+
+    def serve_lm(port=0):
+        eng = GenerationEngine(params, n_heads=2, n_layers=2, max_len=64,
+                               max_sessions=4, compute_dtype=jnp.float32)
+        m = tpulab.InferenceManager(max_exec_concurrency=1)
+        m.register_model("mnist", make_mnist(max_batch_size=1))
+        m.update_resources()
+        m.serve(port=port, generation_engines={"lm": eng})
+        return m, eng
+
+    port_b = free_port()
+    mgr_a, eng = serve_lm()
+    mgr_b, _ = serve_lm(port_b)
+    addrs = [f"127.0.0.1:{mgr_a.server.bound_port}", f"127.0.0.1:{port_b}"]
+    grs = GenerationReplicaSet(addrs, "lm", prefix_affinity=True,
+                               affinity_tokens=3)
+    prompts = [np.arange(4, dtype=np.int32) + s for s in range(4)]
+    expected = {s: list(eng.generate(p[None, :], 6)[0])
+                for s, p in enumerate(prompts)}
+    errors, done = [], []
+
+    def worker(wid):
+        try:
+            for i in range(6):
+                p = prompts[(wid + i) % len(prompts)]
+                got = list(grs.generate(p, 6))
+                assert got == expected[(wid + i) % len(prompts)], (wid, i)
+            done.append(wid)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append((wid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(6)]
+    [t.start() for t in threads]
+    time.sleep(0.3)
+    mgr_b.server.shutdown(grace_s=0.0)  # crash replica 1 mid-soak
+    time.sleep(0.5)
+    mgr_b2, _ = serve_lm(port_b)        # ...and bring it back
+    [t.join(timeout=300) for t in threads]
+    try:
+        assert not any(t.is_alive() for t in threads), "stream threads hung"
+        assert not errors, errors
+        assert len(done) == 6
+        assert grs.inflight == [0, 0], grs.inflight
+        assert sum(grs.served) == 36, grs.served
+    finally:
+        grs.close()
+        for m in (mgr_a, mgr_b, mgr_b2):
+            try:
+                m.shutdown()
+            except Exception:
+                pass

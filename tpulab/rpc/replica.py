@@ -66,8 +66,8 @@ class _BaseReplicaSet:
                                 for a in self.addresses]
             self._m_requests = [metrics.requests.labels(replica=a)
                                 for a in self.addresses]
-            self._m_live = [metrics.live.labels(replica=a)
-                            for a in self.addresses]
+            # live children are NOT pre-created: a gauge child is born at
+            # 0, and "0 = dead" must only ever come from a real probe
 
     # -- metrics hooks (no-ops without a metrics object) --------------------
     def _note_inflight(self, idx: int) -> None:
@@ -104,9 +104,9 @@ class _BaseReplicaSet:
                 out[addr] = {"live": False, "ready": False,
                              "error": f"{type(e).__name__}: {e}"}
         if self._metrics is not None:
-            for i, addr in enumerate(self.addresses):
-                if addr in out:
-                    self._m_live[i].set(1 if out[addr]["live"] else 0)
+            for addr, h in out.items():  # cold path: .labels() is fine here
+                self._metrics.live.labels(replica=addr).set(
+                    1 if h["live"] else 0)
         return out
 
     # -- dispatch -----------------------------------------------------------

@@ -164,6 +164,8 @@ class ReplicaSetMetrics:
             registry=self.registry)
 
 
-def start_metrics_server(metrics: InferenceMetrics, port: int = 9090):
-    """Expose /metrics (reference Exposer on :8080)."""
+def start_metrics_server(metrics, port: int = 9090):
+    """Expose /metrics (reference Exposer on :8080).  Accepts any metrics
+    holder with a ``registry`` attribute (InferenceMetrics,
+    ReplicaSetMetrics, ...)."""
     return start_http_server(port, registry=metrics.registry)
