@@ -34,3 +34,67 @@ def test_profiler_trace_capture(tmp_path):
     for root, _dirs, files in os.walk(log_dir):
         found.extend(files)
     assert found, "profiler produced no trace files"
+
+
+def test_chrome_trace_records_serving_lifecycle(tmp_path):
+    """ChromeTraceRecorder through the serving path: per-request
+    batch_wait/pipeline/respond spans land in a loadable trace file."""
+    import json
+
+    import numpy as np
+
+    import tpulab
+    from tpulab.models.mnist import make_mnist
+    from tpulab.rpc.infer_service import (RemoteInferenceManager,
+                                          build_infer_service)
+    from tpulab.utils.tracing import ChromeTraceRecorder
+
+    rec = ChromeTraceRecorder(max_events=1000)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1, max_buffers=4)
+    mgr.register_model("mnist", make_mnist(max_batch_size=4))
+    mgr.update_resources()
+    server = build_infer_service(mgr, "0.0.0.0:0", batching=True,
+                                 batch_window_s=0.002, trace=rec)
+    server.async_start()
+    server.wait_until_running()
+    remote = RemoteInferenceManager(f"localhost:{server.bound_port}")
+    try:
+        runner = remote.infer_runner("mnist")
+        x = np.zeros((2, 28, 28, 1), np.float32)
+        for _ in range(4):
+            runner.infer(Input3=x).result(timeout=60)
+        assert len(rec) >= 12  # 3 spans per request
+        path = rec.save(str(tmp_path / "trace.json"))
+        doc = json.load(open(path))
+        events = doc["traceEvents"]
+        names = {e["name"] for e in events}
+        assert {"batch_wait", "pipeline", "respond"} <= names
+        for e in events:
+            assert e["ph"] == "X" and e["dur"] >= 0 and "ts" in e
+            assert e["args"]["model"] == "mnist"
+        pipelines = [e for e in events if e["name"] == "pipeline"]
+        assert all("compute_ms" in e["args"] for e in pipelines)
+        # per worker row, each pipeline span starts at (or after) the end
+        # of the batch_wait span preceding it — the lifecycle ordering
+        by_tid = {}
+        for e in sorted(events, key=lambda e: e["ts"]):
+            by_tid.setdefault(e["tid"], []).append(e)
+        for row in by_tid.values():
+            for prev, cur in zip(row, row[1:]):
+                if prev["name"] == "batch_wait" and cur["name"] == "pipeline":
+                    assert cur["ts"] >= prev["ts"] + prev["dur"] - 1e-3
+    finally:
+        remote.close()
+        server.shutdown()
+        mgr.shutdown()
+
+
+def test_chrome_trace_ring_bound():
+    """The event ring stays bounded (long-running servers must not grow)."""
+    from tpulab.utils.tracing import ChromeTraceRecorder
+    rec = ChromeTraceRecorder(max_events=10)
+    import time as _t
+    t = _t.perf_counter()
+    for i in range(50):
+        rec.add_span("s", t, 0.001, tid=1, i=i)
+    assert len(rec) == 10

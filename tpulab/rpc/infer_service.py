@@ -55,9 +55,11 @@ class InferResources(Resources):
     def __init__(self, manager, batching: bool = False,
                  batch_window_s: float = 0.002, metrics=None,
                  generation_engines: Optional[Dict[str, object]] = None,
-                 watchdog=None):
+                 watchdog=None, trace=None):
         self.manager = manager
         self.metrics = metrics
+        #: optional tpulab.utils.tracing.ChromeTraceRecorder
+        self.trace = trace
         self.batching = batching
         self.generation_engines = generation_engines or {}
         self.watchdog = watchdog
@@ -220,6 +222,18 @@ class InferContext(Context):
                 pipeline=(t1 - t0) - queue_s,
                 compute=compute_s or 0.0,
                 respond=t2 - t1)
+            if res.trace is not None:
+                # per-request lifecycle spans on this worker thread's row
+                # (chrome://tracing / perfetto)
+                res.trace.add_span("batch_wait", t0, queue_s,
+                                   model=request.model_name)
+                res.trace.add_span("pipeline", t0 + queue_s,
+                                   (t1 - t0) - queue_s,
+                                   model=request.model_name,
+                                   compute_ms=round(1e3 * (compute_s or 0),
+                                                    3))
+                res.trace.add_span("respond", t1, t2 - t1,
+                                   model=request.model_name)
         except Exception as e:  # noqa: BLE001
             log.exception("inference failed")
             resp.status.code = pb.INTERNAL
@@ -329,7 +343,7 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                         batch_window_s: float = 0.002,
                         metrics=None,
                         generation_engines: Optional[Dict[str, object]] = None,
-                        watchdog=None) -> Server:
+                        watchdog=None, trace=None) -> Server:
     """Wire the inference service onto a Server
     (reference BasicInferService ctor infer.cc:644-678).
 
@@ -338,6 +352,7 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     middleman capability, in-process)."""
     resources = InferResources(manager, batching=batching,
                                batch_window_s=batch_window_s, metrics=metrics,
+                               trace=trace,
                                generation_engines=generation_engines,
                                watchdog=watchdog)
     server = Server(address, executor or Executor(n_threads=4))

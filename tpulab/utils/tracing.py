@@ -12,6 +12,8 @@ equivalent adds the XLA profiler).
 from __future__ import annotations
 
 import contextlib
+import os
+import threading
 import time
 from typing import Dict, Optional
 
@@ -81,3 +83,49 @@ class StageTimer:
     @property
     def total_ms(self) -> float:
         return sum(self.stages_ms.values())
+
+
+class ChromeTraceRecorder:
+    """Host-side request-lifecycle trace in Chrome trace-event format
+    (load in chrome://tracing or ui.perfetto.dev) — the chrome-trace
+    tooling SURVEY §5 notes the reference lacked.
+
+    The serving path (``build_infer_service(trace=recorder)``) records one
+    span per request stage (batch_wait / pipeline / respond) on the
+    handling thread's row; ``save()`` writes the JSON trace.  Collection
+    is thread-safe and bounded (a ring of ``max_events`` — a long-running
+    server keeps the most recent window rather than growing without
+    limit)."""
+
+    def __init__(self, max_events: int = 100_000):
+        import collections
+        self._events = collections.deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+
+    def add_span(self, name: str, start_s: float, dur_s: float,
+                 tid: Optional[int] = None, **args) -> None:
+        """One complete ('X') event; ``start_s`` is a time.perf_counter
+        value from the same process."""
+        ev = {"name": name, "ph": "X", "pid": self._pid,
+              "tid": tid if tid is not None else threading.get_ident(),
+              "ts": round((start_s - self._t0) * 1e6, 3),
+              "dur": round(dur_s * 1e6, 3)}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def save(self, path: str) -> str:
+        import json
+        with self._lock:
+            events = list(self._events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        return path
