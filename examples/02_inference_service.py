@@ -53,6 +53,19 @@ def main():
               batch_window_s=args.batch_window_ms / 1000.0, metrics=metrics)
     print(f"serving {args.model} on :{args.port}, metrics on "
           f":{args.metrics_port}/metrics", flush=True)
+
+    # k8s-native termination: on SIGTERM (pod delete), drain first —
+    # readiness flips so the balancer rotates this replica out, in-flight
+    # requests finish — then shut down inside terminationGracePeriod
+    import signal
+
+    def _term(_sig, _frm):
+        print("SIGTERM: draining", flush=True)
+        mgr.drain(timeout=25.0)
+        mgr.shutdown()
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _term)
     # control lambda: HBM gauge every 2s (reference NVML power gauge,
     # server.cc:322-331)
     mgr.server.run(control_fn=metrics.poll_device, control_period_s=2.0)

@@ -243,8 +243,10 @@ def test_02_inference_service_cli():
         assert "tpulab_request_total" in metrics
         remote.close()
     finally:
-        proc.terminate()
-        proc.wait(timeout=30)
+        proc.terminate()  # SIGTERM -> drain -> clean exit (k8s path)
+        rc = proc.wait(timeout=30)
+    assert rc == 0, (rc, proc.stderr.read()[-1000:] if proc.stderr else "")
+    assert "SIGTERM: draining" in proc.stdout.read()
 
 
 def test_model_store_roundtrip(tmp_path):

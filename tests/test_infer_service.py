@@ -167,3 +167,129 @@ def test_stream_infer_dead_stream_fails_pending():
     finally:
         remote.close()
         mgr.shutdown()
+
+
+def test_graceful_drain_flips_readiness_and_waits_for_inflight():
+    """Rolling-restart drain: readiness false immediately (balancers
+    rotate the replica out), requests in flight — and stragglers arriving
+    during the drain window — still complete."""
+    import threading
+    import time
+
+    import numpy as np
+
+    import tpulab
+    from tpulab.models.mnist import make_mnist
+    from tpulab.rpc.infer_service import RemoteInferenceManager
+
+    mgr = tpulab.InferenceManager(max_exec_concurrency=2, max_buffers=4)
+    mgr.register_model("mnist", make_mnist(max_batch_size=2))
+    mgr.update_resources()
+    mgr.serve(port=0)
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        runner = remote.infer_runner("mnist")
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        runner.infer(Input3=x).result(timeout=60)  # warm
+        assert remote.health().ready
+        # keep a stream of requests going while the drain starts
+        stop, results, errors = threading.Event(), [], []
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    results.append(
+                        runner.infer(Input3=x).result(timeout=60))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+                    return
+
+        t = threading.Thread(target=pump)
+        t.start()
+        time.sleep(0.1)
+        drained = mgr.drain(timeout=30.0, settle_s=0.3)
+        health = remote.health()
+        assert health.live and not health.ready  # rotated out, still alive
+        # drain() returning True means in-flight hit zero at that moment;
+        # the pump may still add stragglers — they must SUCCEED (drain
+        # serves until shutdown, it never rejects)
+        time.sleep(0.2)
+        stop.set()
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert drained
+        assert not errors, errors
+        assert len(results) >= 2
+        assert all(o["Plus214_Output_0"].shape == (1, 10) for o in results)
+    finally:
+        remote.close()
+        mgr.shutdown()
+
+
+def test_drain_waits_for_generation_streams():
+    """Generation streams count toward drain: an in-flight decode must
+    hold drain() open (and finish intact) before shutdown proceeds."""
+    import threading
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import tpulab
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.mnist import make_mnist
+    from tpulab.models.transformer import init_transformer_params
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+
+    params = init_transformer_params(vocab=32, d_model=32, n_heads=2,
+                                     n_layers=1, d_ff=48)
+    eng = GenerationEngine(params, n_heads=2, n_layers=1, max_len=64,
+                           max_sessions=1, compute_dtype=jnp.float32)
+
+    class Paced:
+        def start_session(self, timeout=None):
+            import contextlib
+            cm = eng.start_session(timeout=timeout)
+
+            @contextlib.contextmanager
+            def wrap():
+                with cm as sess:
+                    class S:
+                        prefill = staticmethod(sess.prefill)
+
+                        @staticmethod
+                        def stream(steps):
+                            for tok in sess.stream(steps):
+                                time.sleep(0.03)
+                                yield tok
+                    yield S()
+            return wrap()
+
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": Paced()})
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        toks, t_done = [], [None]
+
+        def consume():
+            toks.extend(GenerateStreamClient(remote, "lm").generate(
+                np.arange(4, dtype=np.int32), 20))
+            t_done[0] = time.monotonic()
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.2)  # stream is in flight
+        t_drained = None
+        drained = mgr.drain(timeout=60.0, settle_s=0.1)
+        t_drained = time.monotonic()
+        t.join(timeout=60)
+        assert drained
+        assert len(toks) == 20  # the stream finished intact
+        assert t_done[0] is not None and t_drained >= t_done[0] - 0.1, \
+            "drain returned while the generation stream was in flight"
+    finally:
+        remote.close()
+        mgr.shutdown()

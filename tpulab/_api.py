@@ -60,6 +60,33 @@ class InferenceManager(_EngineManager):
     def server(self):
         return self._server
 
+    def drain(self, timeout: float = 30.0, poll_s: float = 0.05,
+              settle_s: float = 10.0) -> bool:
+        """Graceful rolling-restart drain (the k8s preStop pattern):
+        readiness flips false immediately — health-checking balancers
+        (envoy/k8s/watchdog-aware clients) rotate this replica out — while
+        in-flight and late-arriving requests keep being served.
+
+        Holds for at least ``settle_s`` even when idle, so the balancer
+        OBSERVES the readiness flip before shutdown (deploy/k8s probes
+        every 10 s — an instant return would leave the endpoint in
+        rotation pointing at a dead server); then waits for in-flight
+        (unary AND generation streams) to reach zero.  Returns drained
+        status; call :meth:`shutdown` after."""
+        import time as _time
+        if self._server is None:
+            return True
+        res = self._server._infer_resources
+        res.draining = True
+        t0 = _time.monotonic()
+        deadline = t0 + max(timeout, settle_s)
+        while _time.monotonic() < deadline:
+            settled = _time.monotonic() - t0 >= settle_s
+            if settled and res.inflight_requests == 0:
+                return True
+            _time.sleep(poll_s)
+        return res.inflight_requests == 0
+
     def shutdown(self) -> None:
         if self._server is not None:
             self._server.shutdown()  # owns the attached service resources
