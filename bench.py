@@ -107,6 +107,35 @@ def _source_round(rec: dict) -> int:
     return int(m.group(1)) if m else 0
 
 
+def _recency_round(rec: dict) -> int:
+    """Round used for RECENCY ordering (not display): an explicit stamp
+    wins; the last-good store's 'latest' without one still ranks newest —
+    it is overwritten on every save, so it is the most recent capture by
+    construction even when TPULAB_BENCH_ROUND wasn't set (e.g. the
+    driver's own end-of-round run)."""
+    if isinstance(rec.get("round"), int):
+        return rec["round"]
+    if str(rec.get("source_file", "")).endswith("BENCH_LAST_GOOD:latest"):
+        return 10 ** 6
+    return _source_round(rec)
+
+
+_PHASE_RANK = {"EARLY": 1, "MID": 2, "LATE": 3}
+
+
+def _source_phase(rec: dict) -> int:
+    """Within-round capture order from the source name: EARLY < MID <
+    LATE; the last-good store's 'latest' outranks any file of its round
+    (it is by definition the most recent save), 'best' ranks lowest
+    (could be any age)."""
+    sf = str(rec.get("source_file", ""))
+    if sf.startswith("BENCH_LAST_GOOD"):
+        return 9 if sf.endswith("latest") else 0
+    import re
+    m = re.match(r"BENCH_([A-Z]+)_r", sf)
+    return _PHASE_RANK.get(m.group(1), 2) if m else 2
+
+
 def _record_age_str(rec: dict, now: float | None = None) -> str:
     """Human age of a capture ('3.2 d old'), or 'unknown age'."""
     ts = rec.get("captured_at")
@@ -126,8 +155,10 @@ def _load_last_good() -> dict | None:
 
     Selection policy (VERDICT r3 weak #6): latest-good, NOT best-ever — a
     historical best would age well past reality if live captures keep
-    failing.  Order: capture timestamp desc; untimestamped records rank
-    below any timestamped one, ordered by source round, then by value."""
+    failing.  Recency is ordered by what is structurally TRUE before what
+    is merely stamped: source round, then within-round capture phase
+    (EARLY < MID < LATE — a stamped EARLY record must not outrank its
+    round's newer unstamped MID), then capture timestamp, then value."""
     cands = []
     try:
         if os.path.exists(LAST_GOOD_PATH):
@@ -152,8 +183,8 @@ def _load_last_good() -> dict | None:
     cands = [r for r in cands if _is_on_device_record(r)]
     if not cands:
         return None
-    return max(cands, key=lambda r: (str(r.get("captured_at") or ""),
-                                     _source_round(r),
+    return max(cands, key=lambda r: (_recency_round(r), _source_phase(r),
+                                     str(r.get("captured_at") or ""),
                                      float(r.get("value", 0) or 0)))
 
 

@@ -37,13 +37,26 @@ def test_latest_good_beats_best_ever(repo):
     assert lg["value"] == 120.0  # newer wins even though older is bigger
 
 
-def test_untimestamped_ranks_below_any_timestamped(repo):
+def test_newer_round_beats_older_regardless_of_stamps(repo):
     _write(str(repo / "docs" / "BENCH_MID_r02.json"),
            {"value": 900.0, "device": "TPU v4"})  # no captured_at
     _write(str(repo / "docs" / "BENCH_EARLY_r03.json"),
            {"value": 100.0, "device": "TPU v4",
             "captured_at": "2026-06-01T00:00:00Z"})
     assert bench._load_last_good()["value"] == 100.0
+
+
+def test_same_round_phase_order_beats_timestamp(repo):
+    """A stamped EARLY capture must not outrank its round's newer
+    unstamped MID capture (the round-2 artifact shape that inverted
+    recency under a timestamp-first policy)."""
+    _write(str(repo / "docs" / "BENCH_EARLY_r02.json"),
+           {"value": 30.3, "device": "TPU v5 lite",
+            "captured_at": "2026-07-29T10:31:08Z"})
+    _write(str(repo / "docs" / "BENCH_MID_r02.json"),
+           {"value": 96.7, "device": "TPU v5 lite"})  # newer, unstamped
+    lg = bench._load_last_good()
+    assert lg["value"] == 96.7, lg
 
 
 def test_untimestamped_tie_broken_by_source_round(repo):
@@ -109,3 +122,18 @@ def test_emit_on_device_saves_last_good(repo, monkeypatch, capsys):
     assert store["latest"]["round"] == 4
     assert store["latest"]["captured_at"]
     assert store["best"]["value"] == 150.0
+
+
+def test_store_latest_without_round_stamp_still_ranks_newest(repo):
+    """The driver's own end-of-round bench run has no TPULAB_BENCH_ROUND:
+    its saved 'latest' carries a timestamp but no round stamp — it must
+    still outrank any stale docs BENCH_*_rNN file (it is overwritten on
+    every save, newest by construction)."""
+    _write(str(repo / "docs" / "BENCH_MID_r02.json"),
+           {"value": 900.0, "device": "TPU v4",
+            "captured_at": "2026-05-01T00:00:00Z"})
+    _write(str(repo / "docs" / "BENCH_LAST_GOOD.json"),
+           {"latest": {"value": 150.0, "device": "TPU v4",
+                       "captured_at": "2026-07-28T00:00:00Z"}})
+    lg = bench._load_last_good()
+    assert lg["value"] == 150.0, lg
