@@ -63,6 +63,10 @@ def main():
     ap.add_argument("--stop-token", type=int, default=None)
     ap.add_argument("--device-sampling", action="store_true",
                     help="temperature sampling computed on-chip")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="expose LLM serving metrics (tpulab_llm_*: "
+                         "tokens/s, lanes, pages, prefix-cache, "
+                         "preemptions) on this /metrics port")
     ap.add_argument("--oneshot", action="store_true",
                     help="server exits after first client disconnect (tests)")
     args = ap.parse_args()
@@ -151,6 +155,26 @@ def main():
             n_kv_heads=kv_heads, k=args.speculative, max_len=args.max_len,
             compute_dtype=jnp.float32, rope_theta=rope_theta)
         engines["llm-spec"] = SpeculativeSessionEngine(spec, max_sessions=2)
+
+    gm = None
+    if args.metrics_port:
+        import threading
+
+        from tpulab.utils.metrics import (GenerationMetrics,
+                                          start_metrics_server)
+        gm = GenerationMetrics()
+        start_metrics_server(gm, port=args.metrics_port)
+
+        def poll_loop():
+            while True:
+                try:
+                    gm.poll(cb)
+                except Exception:
+                    return  # batcher gone: server is shutting down
+                time.sleep(2.0)
+        import time
+        threading.Thread(target=poll_loop, daemon=True,
+                         name="llm-metrics").start()
 
     # generation-only deployment: no dense models, just the Generate RPC
     mgr = tpulab.InferenceManager(max_exec_concurrency=1)

@@ -123,7 +123,8 @@ def test_grafana_dashboard_matches_exported_metrics():
     wanted = set()
     for e in exprs:
         wanted.update(re.findall(r"(tpulab_[a-z0-9_]+)", e))
-    from tpulab.utils.metrics import InferenceMetrics, ReplicaSetMetrics
+    from tpulab.utils.metrics import (GenerationMetrics, InferenceMetrics,
+                                      ReplicaSetMetrics)
     m = InferenceMetrics()
     m.observe_request(0.01, 0.005)  # populate histogram child series
     rm = ReplicaSetMetrics()
@@ -131,8 +132,9 @@ def test_grafana_dashboard_matches_exported_metrics():
     rm.inflight.labels(replica="x").set(0)
     rm.live.labels(replica="x").set(1)
     rm.failovers.inc()
+    gm = GenerationMetrics()
     exported = set()
-    for reg in (m.registry, rm.registry):
+    for reg in (m.registry, rm.registry, gm.registry):
         for metric in reg.collect():
             for s in metric.samples:
                 exported.add(s.name)
@@ -304,13 +306,53 @@ def test_notebook_llm_serving():
     assert out.stdout.strip().endswith("done")
 
 
-def _spawn_llm_server(env, *extra_args):
+def _spawn_llm_server(env, *extra_args, oneshot=True):
     return subprocess.Popen(
         [sys.executable, f"{REPO}/examples/07_llm_server.py", "--cpu",
-         "--port", "0", "--oneshot", "--max-len", "128", "--lanes", "2",
-         *extra_args],
+         "--port", "0", "--max-len", "128", "--lanes", "2",
+         *(["--oneshot"] if oneshot else []), *extra_args],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env)
+
+
+def test_07_llm_server_metrics_export():
+    """--metrics-port: tpulab_llm_* series reflect real serving (tokens
+    generated, prefix-cache state) after a generation completes."""
+    import urllib.request
+    from tests.conftest import free_port
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "TPULAB_FORCE_CPU": "1", "HOME": "/tmp"}
+    mport = free_port()
+    # no --oneshot: the server must outlive the request for the scrape
+    srv = _spawn_llm_server(env, "--metrics-port", str(mport),
+                            oneshot=False)
+    try:
+        port = _wait_llm_port(srv)
+        out = subprocess.run(
+            [sys.executable, f"{REPO}/examples/07_llm_server.py", "--cpu",
+             "--connect", f"localhost:{port}", "--prompt", "5,6,7",
+             "--steps", "6"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        deadline = time.time() + 30  # poller samples every 2s
+        body = ""
+        while time.time() < deadline:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{mport}/metrics", timeout=10
+            ).read().decode()
+            if ("tpulab_llm_tokens_total 6.0" in body
+                    and "tpulab_llm_requests_completed_total 1.0" in body):
+                break  # both settled: no race with deferred completion
+            time.sleep(1)
+        assert "tpulab_llm_tokens_total 6.0" in body, body[-1200:]
+        assert "tpulab_llm_requests_completed_total 1.0" in body
+        import re
+        free = float(re.search(r"^tpulab_llm_free_pages (\S+)$", body,
+                               re.M).group(1))
+        assert free > 0, "all pages released after completion"
+    finally:
+        srv.kill()
 
 
 def _wait_llm_port(srv, deadline_s=120.0):

@@ -756,6 +756,7 @@ class ContinuousBatcher:
         self._admit_counter = 0
         self.preemptions = 0
         self.completed_requests = 0  # futures resolved successfully
+        self.tokens_generated = 0    # emitted across all requests
         self._cv = threading.Condition()
         self._shutdown = False
         self._thread = threading.Thread(target=self._run, name="cbatch",
@@ -839,6 +840,11 @@ class ContinuousBatcher:
     def active_lanes(self) -> int:
         with self._cv:
             return sum(r is not None for r in self._active)
+
+    @property
+    def queued_requests(self) -> int:
+        with self._cv:
+            return len(self._queue)
 
     # -- scheduler ----------------------------------------------------------
     def _enqueue_locked(self, req: _PagedRequest,
@@ -1095,6 +1101,7 @@ class ContinuousBatcher:
             else:
                 tok = sp.pick(np.asarray(last_logits))
             req.tokens_out.append(tok)
+            self.tokens_generated += 1
             lp = None
             if req.want_logprobs:
                 # same f32 device log_softmax as paged_decode_step: one
@@ -1227,6 +1234,7 @@ class ContinuousBatcher:
                     continue
                 req.length += 1
                 req.tokens_out.append(int(next_tokens[lane]))
+                self.tokens_generated += 1
                 lp = (float(logprobs_arr[lane])
                       if logprobs_arr is not None else None)
                 if req.want_logprobs:

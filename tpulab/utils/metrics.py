@@ -164,6 +164,68 @@ class ReplicaSetMetrics:
             registry=self.registry)
 
 
+class GenerationMetrics:
+    """LLM-serving observability for a ContinuousBatcher: lane/queue/page
+    gauges plus token/request/preemption/prefix-cache counters.  Sampled
+    by ``poll(batcher)`` (cheap attribute reads; counters advance by the
+    delta since the last poll, so rate() works in PromQL)."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.active_lanes = Gauge(
+            f"{ns}_llm_active_lanes", "Decode lanes in use",
+            registry=self.registry)
+        self.queued = Gauge(
+            f"{ns}_llm_queued_requests", "Requests waiting for a lane",
+            registry=self.registry)
+        self.free_pages = Gauge(
+            f"{ns}_llm_free_pages", "KV pool pages free",
+            registry=self.registry)
+        self.tokens = Counter(
+            f"{ns}_llm_tokens", "Tokens generated",
+            registry=self.registry)
+        self.completed = Counter(
+            f"{ns}_llm_requests_completed", "Generation requests completed",
+            registry=self.registry)
+        self.preemptions = Counter(
+            f"{ns}_llm_preemptions", "Priority preemptions",
+            registry=self.registry)
+        self.prefix_hits = Counter(
+            f"{ns}_llm_prefix_cache_hits", "Prefix-cache page hits",
+            registry=self.registry)
+        self.prefix_misses = Counter(
+            f"{ns}_llm_prefix_cache_misses", "Prefix pages computed fresh",
+            registry=self.registry)
+        self._last: Dict[str, int] = {}
+
+    def _advance(self, counter, key: str, value: int) -> None:
+        delta = value - self._last.get(key, 0)
+        if delta > 0:
+            counter.inc(delta)
+        self._last[key] = value
+
+    def poll(self, batcher) -> None:
+        """Sample a ContinuousBatcher (control-loop / poller hook)."""
+        self.active_lanes.set(batcher.active_lanes)
+        self.queued.set(batcher.queued_requests)
+        try:
+            self.free_pages.set(batcher.pool.free_pages)
+        except Exception:  # pragma: no cover - closed pool during teardown
+            pass
+        self._advance(self.tokens, "tokens", batcher.tokens_generated)
+        self._advance(self.completed, "completed",
+                      batcher.completed_requests)
+        self._advance(self.preemptions, "preempt", batcher.preemptions)
+        pc = getattr(batcher, "prefix_cache", None)
+        if pc is not None:
+            self._advance(self.prefix_hits, "hits", pc.hits)
+            self._advance(self.prefix_misses, "misses", pc.misses)
+
+
 def start_metrics_server(metrics, port: int = 9090):
     """Expose /metrics (reference Exposer on :8080).  Accepts any metrics
     holder with a ``registry`` attribute (InferenceMetrics,
