@@ -450,3 +450,45 @@ def test_notebook_scale_out_serving():
         capture_output=True, text=True, timeout=420, env=env)
     assert out.returncode == 0, out.stderr[-3000:]
     assert "scale-out serving tour complete" in out.stdout
+
+
+def test_12_flatbuffers_rejects_malformed_payloads():
+    """Untrusted wire bytes must surface as clean RPC errors — raised at
+    whichever layer catches them (empty buffers in the deserializer,
+    garbage/truncation during lazy field access, a decoded-but-empty
+    message at model lookup) — and never crash the server, verified by a
+    good request succeeding afterwards."""
+    import grpc
+
+    import tpulab
+    from examples_helpers import load_example
+    from tpulab.models.mnist import make_mnist
+    from tpulab.rpc import ClientExecutor, ClientUnary
+
+    mod = load_example("12_flatbuffers")
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1, max_buffers=2)
+    mgr.register_model("mnist", make_mnist(max_batch_size=2))
+    mgr.update_resources()
+    server = mod.build_service(mgr)
+    server.async_start()
+    server.wait_until_running()
+    try:
+        x = np.zeros((1, 28, 28, 1), np.float32)
+        good = mod.encode_request("mnist", msg_id=1, Input3=x)
+        with ClientExecutor(f"127.0.0.1:{server.bound_port}") as cx:
+            infer = ClientUnary(cx, f"/{mod.SERVICE}/Infer",
+                                request_serializer=lambda b: b,
+                                response_deserializer=lambda b: b)
+            for bad in (b"", b"\x00" * 4, b"garbage-not-a-flatbuffer",
+                        good[: len(good) // 3]):
+                with pytest.raises(grpc.RpcError) as exc_info:
+                    infer.call(bad, timeout=30)
+                # a clean rejection, not a server stall
+                assert (exc_info.value.code()
+                        is not grpc.StatusCode.DEADLINE_EXCEEDED)
+            # the server survived every malformed payload
+            resp = mod.InferResponseReader(infer.call(good, timeout=60))
+            assert resp.tensors()["Plus214_Output_0"].shape == (1, 10)
+    finally:
+        server.shutdown()
+        mgr.shutdown()
