@@ -137,3 +137,25 @@ def test_store_latest_without_round_stamp_still_ranks_newest(repo):
                        "captured_at": "2026-07-28T00:00:00Z"}})
     lg = bench._load_last_good()
     assert lg["value"] == 150.0, lg
+
+
+def test_partial_save_never_displaces_complete_latest(repo, monkeypatch):
+    """A watchdog-cut (TIMEOUT) save lands under latest_partial: within a
+    round the complete record still wins; across rounds an explicitly
+    newer partial outranks an old complete."""
+    monkeypatch.setenv("TPULAB_BENCH_ROUND", "4")
+    bench._save_last_good({"value": 150.0, "device": "TPU v5",
+                           "details": {}})
+    bench._save_last_good({"value": 40.0,
+                           "device": "TPU v5 (TIMEOUT during phase 'x')",
+                           "details": {}})
+    store = json.load(open(bench.LAST_GOOD_PATH))
+    assert store["latest"]["value"] == 150.0      # untouched by the cut
+    assert store["latest_partial"]["value"] == 40.0
+    assert bench._load_last_good()["value"] == 150.0  # same round: complete
+    # newer round with ONLY a partial: recency wins over the old complete
+    monkeypatch.setenv("TPULAB_BENCH_ROUND", "5")
+    bench._save_last_good({"value": 55.0,
+                           "device": "TPU v5 (TIMEOUT during phase 'y')",
+                           "details": {}})
+    assert bench._load_last_good()["value"] == 55.0
