@@ -88,13 +88,20 @@ def _save_last_good(line: dict) -> None:
         # recent COMPLETE capture (within a round, complete outranks
         # partial via _source_phase; across rounds, explicit round stamps
         # keep recency honest)
-        if "(TIMEOUT" in str(rec.get("device", "")):
+        partial = "(TIMEOUT" in str(rec.get("device", ""))
+        if partial:
             store["latest_partial"] = rec
         else:
             store["latest"] = rec
-        if (not isinstance(store.get("best"), dict)
-                or float(store["best"].get("value", 0))
-                <= float(rec["value"])):
+            # a complete capture supersedes any earlier partial: without
+            # this, a stale unstamped partial's newest-by-construction
+            # recency rank would outlive every later complete save
+            store.pop("latest_partial", None)
+        # 'best' tracks COMPLETE captures only — a watchdog-cut record's
+        # headline is a noisy preflight burst, not a best
+        if not partial and (not isinstance(store.get("best"), dict)
+                            or float(store["best"].get("value", 0))
+                            <= float(rec["value"])):
             store["best"] = rec
         os.makedirs(os.path.dirname(LAST_GOOD_PATH), exist_ok=True)
         tmp = LAST_GOOD_PATH + ".tmp"

@@ -58,6 +58,9 @@ def main():
     ap.add_argument("--prompt", default="1,2,3,4")
     ap.add_argument("--steps", type=int, default=16)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-p", type=float, default=0.0,
+                    help="nucleus sampling mass in (0, 1); takes effect "
+                         "with --temperature > 0")
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--priority", type=int, default=0)
     ap.add_argument("--stop-token", type=int, default=None)
@@ -80,8 +83,8 @@ def main():
         prompt = np.asarray([int(t) for t in args.prompt.split(",")],
                             np.int32)
         stops = [args.stop_token] if args.stop_token is not None else ()
-        kw = dict(temperature=args.temperature, seed=args.seed,
-                  priority=args.priority, stop_tokens=stops,
+        kw = dict(temperature=args.temperature, top_p=args.top_p,
+                  seed=args.seed, priority=args.priority, stop_tokens=stops,
                   device_sampling=args.device_sampling)
         if "," in args.connect:
             # N replicas: least-loaded routing + exactly-once crash

@@ -159,3 +159,22 @@ def test_partial_save_never_displaces_complete_latest(repo, monkeypatch):
                            "device": "TPU v5 (TIMEOUT during phase 'y')",
                            "details": {}})
     assert bench._load_last_good()["value"] == 55.0
+
+
+def test_complete_save_supersedes_partial_and_guards_best(repo, monkeypatch):
+    """A complete save clears latest_partial (a stale unstamped partial
+    must not outlive later completes via the newest-by-construction
+    rank), and partials never define 'best'."""
+    monkeypatch.delenv("TPULAB_BENCH_ROUND", raising=False)
+    bench._save_last_good({"value": 999.0,
+                           "device": "TPU (TIMEOUT during phase 'x')",
+                           "details": {}})
+    store = json.load(open(bench.LAST_GOOD_PATH))
+    assert "best" not in store          # partial never defines best
+    assert store["latest_partial"]["value"] == 999.0
+    bench._save_last_good({"value": 120.0, "device": "TPU v5",
+                           "details": {}})
+    store = json.load(open(bench.LAST_GOOD_PATH))
+    assert "latest_partial" not in store  # superseded by the complete
+    assert store["best"]["value"] == 120.0
+    assert bench._load_last_good()["value"] == 120.0

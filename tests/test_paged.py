@@ -838,3 +838,31 @@ def test_generate_rpc_device_sampling(lm):
         mgr.shutdown()
         cb.shutdown()
         ref_cb.shutdown()
+
+
+def test_sampling_top_p_nucleus():
+    """Nucleus truncation: only the smallest prob-descending prefix with
+    mass >= top_p can be sampled; composes after top_k; validation and
+    the device-sampling rejection mirror top_k's contract."""
+    import numpy as np
+    import pytest
+
+    from tpulab.engine.paged import SamplingParams
+    logits = np.log(np.array([0.5, 0.3, 0.15, 0.05]))
+    # top_p=0.6: {0.5, 0.3} is the smallest prefix with mass >= 0.6
+    sp = SamplingParams(temperature=1.0, top_p=0.6, seed=7)
+    draws = {sp.pick(logits) for _ in range(200)}
+    assert draws <= {0, 1} and draws == {0, 1}
+    # tiny top_p degenerates to argmax-only
+    sp1 = SamplingParams(temperature=1.0, top_p=0.01, seed=7)
+    assert {sp1.pick(logits) for _ in range(50)} == {0}
+    # top_k=2 then top_p=0.99 over the renormalized pair: still {0,1}
+    spk = SamplingParams(temperature=1.0, top_k=2, top_p=0.99, seed=7)
+    assert {spk.pick(logits) for _ in range(200)} == {0, 1}
+    # top_p=1.0 disables truncation (all four reachable)
+    sp_all = SamplingParams(temperature=1.0, top_p=1.0, seed=7)
+    assert len({sp_all.pick(logits) for _ in range(400)}) == 4
+    with pytest.raises(ValueError, match="top_p"):
+        SamplingParams(temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError, match="static shape"):
+        SamplingParams(temperature=1.0, top_p=0.9, device=True)

@@ -712,3 +712,44 @@ def test_speculative_completion_accounting():
         with pytest.raises(ValueError, match="max_len"):
             next(s.stream(30))
     assert eng.completed_requests == 2
+
+
+def test_top_p_over_generate_rpc():
+    """top_p flows wire -> SamplingParams: with a seeded request the RPC
+    stream equals local sampling with identical params, and
+    device_sampling+top_p is rejected like top_k."""
+    import jax.numpy as jnp
+
+    from tpulab.engine.paged import ContinuousBatcher, SamplingParams
+    from tpulab.models.transformer import init_transformer_params
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          GenerationRejected,
+                                          RemoteInferenceManager)
+
+    params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                     n_layers=1, d_ff=64)
+    cb = ContinuousBatcher(params, n_heads=2, n_layers=1, lanes=2,
+                           max_len=64, compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": cb})
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        prompt = np.arange(5, dtype=np.int32)
+        client = GenerateStreamClient(remote, "lm")
+        got = list(client.generate(prompt, 8, temperature=0.8, top_p=0.7,
+                                   seed=11))
+        want = list(cb.submit(
+            prompt, 8, sampling=SamplingParams(
+                temperature=0.8, top_p=0.7, seed=11)).result(timeout=120))
+        assert got == want, (got, want)
+        with pytest.raises(GenerationRejected, match="top_k/top_p"):
+            list(client.generate(prompt, 4, temperature=0.8, top_p=0.7,
+                                 device_sampling=True))
+        with pytest.raises(GenerationRejected, match="top_p must be"):
+            list(client.generate(prompt, 4, temperature=0.8, top_p=1.5))
+    finally:
+        remote.close()
+        mgr.shutdown()
+        cb.shutdown()

@@ -570,23 +570,31 @@ class SamplingParams:
     (seed, position)) — the tick fetches only (B,) token ids, never the
     logits.  Reproducible per request (the key depends only on seed and
     position, not batch-mates or preemption) but a DIFFERENT stream than
-    the host PRNG.  ``top_k`` is a host-side feature: device=True with
-    top_k > 0 is rejected (per-lane k cannot be a static compile-time
-    shape).
+    the host PRNG.  ``top_k`` / ``top_p`` are host-side features:
+    device=True with either set is rejected (per-lane truncation is not
+    a static compile-time shape).
+
+    ``top_p`` (nucleus sampling, 0 < top_p < 1) keeps the smallest set
+    of tokens whose probabilities sum to at least top_p; composes with
+    ``top_k`` (k-truncation first, then the nucleus), the standard order.
     """
 
-    __slots__ = ("temperature", "top_k", "device", "seed", "_rng")
+    __slots__ = ("temperature", "top_k", "top_p", "device", "seed", "_rng")
 
     def __init__(self, temperature: float = 0.0, top_k: int = 0,
-                 seed: Optional[int] = None, device: bool = False):
+                 seed: Optional[int] = None, device: bool = False,
+                 top_p: float = 0.0):
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
-        if device and top_k > 0:
-            raise ValueError("device sampling does not support top_k "
-                             "(per-lane k is not a static shape); use "
-                             "host sampling for top-k")
+        if not 0.0 <= top_p <= 1.0:
+            raise ValueError("top_p must be in [0, 1]")
+        if device and (top_k > 0 or 0.0 < top_p < 1.0):
+            raise ValueError("device sampling does not support top_k/top_p "
+                             "(per-lane truncation is not a static shape); "
+                             "use host sampling")
         self.temperature = temperature
         self.top_k = top_k
+        self.top_p = top_p
         self.device = device
         if seed is None:
             # full 64-bit draw: device sampling keys on both seed words,
@@ -608,6 +616,15 @@ class SamplingParams:
         z = z - z.max()
         p = np.exp(z)
         p /= p.sum()
+        if 0.0 < self.top_p < 1.0:
+            # nucleus: smallest prob-descending prefix summing >= top_p
+            order = np.argsort(p)[::-1]
+            csum = np.cumsum(p[order])
+            cut = int(np.searchsorted(csum, self.top_p)) + 1
+            mask = np.zeros_like(p, dtype=bool)
+            mask[order[:cut]] = True
+            p = np.where(mask, p, 0.0)
+            p /= p.sum()
         return int(self._rng.choice(z.shape[0], p=p))
 
 

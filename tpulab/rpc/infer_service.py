@@ -443,11 +443,17 @@ class GenerateContext(StreamingContext):
                 code=pb.UNKNOWN_MODEL,
                 message=f"no generation engine for {request.model_name!r}")))
             return
-        if request.device_sampling and request.top_k > 0:
+        if request.device_sampling and (request.top_k > 0
+                                        or 0.0 < request.top_p < 1.0):
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
                 code=pb.INVALID_ARGUMENT,
-                message="device_sampling does not support top_k (host-side "
-                        "feature)")))
+                message="device_sampling does not support top_k/top_p "
+                        "(host-side features)")))
+            return
+        if not 0.0 <= request.top_p <= 1.0:
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT,
+                message="top_p must be in [0, 1]")))
             return
         if not (request.temperature >= 0.0):  # rejects negatives AND NaN
             # mirror SamplingParams' local contract instead of silently
@@ -513,6 +519,7 @@ class GenerateContext(StreamingContext):
                 from tpulab.engine.paged import SamplingParams
                 sampling = SamplingParams(
                     temperature=request.temperature, top_k=request.top_k,
+                    top_p=request.top_p,
                     seed=request.seed if request.HasField("seed") else None,
                     device=request.device_sampling)
             fut = engine.submit(np.asarray(request.prompt, np.int32),
@@ -579,7 +586,7 @@ class GenerateStreamClient:
                  priority: int = 0, temperature: float = 0.0,
                  top_k: int = 0, seed: Optional[int] = None,
                  stop_tokens=(), device_sampling: bool = False,
-                 return_logprobs: bool = False):
+                 return_logprobs: bool = False, top_p: float = 0.0):
         """Yields token ids; with ``return_logprobs=True`` yields
         ``(token, logprob)`` pairs instead."""
         import queue as _q
@@ -594,6 +601,7 @@ class GenerateStreamClient:
             model_name=self.model_name,
             prompt=list(np.asarray(prompt, np.int32)), steps=steps,
             priority=priority, temperature=temperature, top_k=top_k,
+            top_p=top_p,
             stop_tokens=[int(t) for t in stop_tokens],
             device_sampling=device_sampling,
             return_logprobs=return_logprobs)
