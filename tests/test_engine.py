@@ -122,6 +122,15 @@ def test_manager_requires_allocation():
     mgr.shutdown()
 
 
+def test_compiled_model_flops(manager):
+    """XLA cost-analysis FLOPs (the bench's MFU numerator): positive,
+    and scales with the batch bucket."""
+    c = manager.compiled("mnist")
+    f1, f4 = c.flops(1), c.flops(4)
+    assert f1 is not None and f1 > 0
+    assert f4 is not None and f4 > 2 * f1  # whole-batch count, not per-row
+
+
 def test_manager_two_level_acquisition(manager):
     with manager.get_execution_context("mnist") as ctx:
         assert ctx.model.name == "mnist"

@@ -1,0 +1,269 @@
+"""ONNX import: golden-check against the reference's bundled model-zoo
+artifact (reference examples/ONNX + models/onnx/mnist-v1.3 test vectors,
+run_onnx_tests-style comparison), plus a synthesized resnet-class graph
+exercising the conv/bn/pool/gemm/softmax op set end-to-end.
+
+The synthesizer below is a ~60-line protobuf wire-format *encoder* — it
+round-trips the importer's decoder against independently constructed
+bytes, so a field-number mistake on either side fails loudly.
+"""
+
+import math
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from tpulab.models.onnx_import import (OnnxModel, load_onnx_model,
+                                       load_tensor_pb, parse_onnx)
+
+REF_MNIST = "/root/reference/models/onnx/mnist-v1.3"
+
+
+# --------------------------------------------------------------- encoder ---
+def _vi(x: int) -> bytes:
+    x &= (1 << 64) - 1  # negatives as 64-bit two's complement (proto spec)
+    out = b""
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _ld(fno: int, payload: bytes) -> bytes:
+    return _vi((fno << 3) | 2) + _vi(len(payload)) + payload
+
+
+def _vint(fno: int, v: int) -> bytes:
+    return _vi(fno << 3) + _vi(v)
+
+
+def _tensor(name: str, arr: np.ndarray) -> bytes:
+    dt = {np.dtype(np.float32): 1, np.dtype(np.int64): 7}[arr.dtype]
+    out = b"".join(_vint(1, d) for d in arr.shape)
+    out += _vint(2, dt) + _ld(8, name.encode()) + _ld(9, arr.tobytes())
+    return out
+
+
+def _attr(name: str, val) -> bytes:
+    out = _ld(1, name.encode())
+    if isinstance(val, float):
+        out += _vi((2 << 3) | 5) + struct.pack("<f", val)
+    elif isinstance(val, int):
+        out += _vint(3, val)
+    elif isinstance(val, bytes):
+        out += _ld(4, val)
+    elif isinstance(val, list):
+        out += b"".join(_vint(8, v) for v in val)
+    else:
+        raise TypeError(val)
+    return out
+
+
+def _node(op: str, ins, outs, **attrs) -> bytes:
+    out = b"".join(_ld(1, i.encode()) for i in ins)
+    out += b"".join(_ld(2, o.encode()) for o in outs)
+    out += _ld(4, op.encode())
+    out += b"".join(_ld(5, _attr(k, v)) for k, v in attrs.items())
+    return out
+
+
+def _value_info(name: str, dims) -> bytes:
+    shape = b"".join(_ld(1, _vint(1, d)) for d in dims)
+    tensor_type = _vint(1, 1) + _ld(2, shape)        # elem_type=f32, shape
+    return _ld(1, name.encode()) + _ld(2, _ld(1, tensor_type))
+
+
+def _model_bytes(nodes, inits, inputs, outputs, opset: int = 13) -> bytes:
+    g = b"".join(_ld(1, n) for n in nodes)
+    g += _ld(2, b"testgraph")
+    g += b"".join(_ld(5, _tensor(n, a)) for n, a in inits.items())
+    g += b"".join(_ld(11, _value_info(n, d)) for n, d in inputs)
+    g += b"".join(_ld(12, _value_info(n, d)) for n, d in outputs)
+    return (_vint(1, 7) + _ld(7, g)
+            + _ld(8, _ld(1, b"") + _vint(2, opset)))
+
+
+# ------------------------------------------------------- synthetic graph ---
+@pytest.fixture(scope="module")
+def resnet_block_onnx(tmp_path_factory):
+    """Conv(+bias,pads) -> BN -> Relu -> MaxPool -> 1x1 Conv -> residual
+    Add -> GlobalAveragePool -> Flatten -> Gemm(transB) -> Softmax."""
+    rng = np.random.default_rng(7)
+    f32 = lambda *s: rng.standard_normal(s).astype(np.float32)  # noqa: E731
+    inits = {
+        "w1": f32(4, 3, 3, 3), "b1": f32(4),
+        "bn_s": np.abs(f32(4)) + 0.5, "bn_b": f32(4),
+        "bn_m": f32(4), "bn_v": np.abs(f32(4)) + 0.5,
+        "w2": f32(4, 4, 1, 1),
+        "wfc": f32(5, 4), "bfc": f32(5),
+    }
+    nodes = [
+        _node("Conv", ["x", "w1", "b1"], ["c1"], kernel_shape=[3, 3],
+              strides=[1, 1], pads=[1, 1, 1, 1]),
+        _node("BatchNormalization", ["c1", "bn_s", "bn_b", "bn_m", "bn_v"],
+              ["n1"], epsilon=1e-5),
+        _node("Relu", ["n1"], ["r1"]),
+        _node("MaxPool", ["r1"], ["p1"], kernel_shape=[2, 2],
+              strides=[2, 2]),
+        _node("Conv", ["p1", "w2"], ["c2"], kernel_shape=[1, 1]),
+        _node("Add", ["c2", "p1"], ["res"]),
+        _node("GlobalAveragePool", ["res"], ["gap"]),
+        _node("Flatten", ["gap"], ["flat"], axis=1),
+        _node("Gemm", ["flat", "wfc", "bfc"], ["fc"], transB=1),
+        _node("Softmax", ["fc"], ["probs"], axis=-1),
+    ]
+    data = _model_bytes(nodes, inits, [("x", [1, 3, 8, 8])],
+                        [("probs", [1, 5])])
+    path = tmp_path_factory.mktemp("onnx") / "block.onnx"
+    path.write_bytes(data)
+    return str(path), inits
+
+
+def _expected_block(inits, x):
+    """The same graph in plain numpy (scipy-free conv via explicit loops
+    would crawl; jax is already a test dependency — use lax directly)."""
+    import jax
+    from jax import lax
+
+    dn = lax.conv_dimension_numbers(x.shape, inits["w1"].shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    c1 = lax.conv_general_dilated(x, inits["w1"], (1, 1),
+                                  [(1, 1), (1, 1)], dimension_numbers=dn)
+    c1 = c1 + inits["b1"].reshape(1, -1, 1, 1)
+    inv = inits["bn_s"] / np.sqrt(inits["bn_v"] + 1e-5)
+    n1 = c1 * inv.reshape(1, -1, 1, 1) + (
+        inits["bn_b"] - inits["bn_m"] * inv).reshape(1, -1, 1, 1)
+    r1 = np.maximum(np.asarray(n1), 0)
+    b, c, h, w = r1.shape
+    p1 = r1.reshape(b, c, h // 2, 2, w // 2, 2).max((3, 5))
+    dn2 = lax.conv_dimension_numbers(p1.shape, inits["w2"].shape,
+                                     ("NCHW", "OIHW", "NCHW"))
+    c2 = np.asarray(lax.conv_general_dilated(p1, inits["w2"], (1, 1),
+                                             [(0, 0), (0, 0)],
+                                             dimension_numbers=dn2))
+    res = c2 + p1
+    gap = res.mean((2, 3))
+    fc = gap @ inits["wfc"].T + inits["bfc"]
+    return np.asarray(jax.nn.softmax(fc, axis=-1))
+
+
+def test_synthetic_resnet_block(resnet_block_onnx):
+    path, inits = resnet_block_onnx
+    om = parse_onnx(path)
+    assert om.opset == 13
+    assert [n.op for n in om.graph.nodes][:2] == ["Conv", "BatchNormalization"]
+    m = load_onnx_model(path, max_batch_size=4)
+    x = np.random.default_rng(3).standard_normal((1, 3, 8, 8)).astype(
+        np.float32)
+    got = np.asarray(m.apply_fn(m.params, {"x": x})["probs"])
+    np.testing.assert_allclose(got, _expected_block(inits, x),
+                               rtol=1e-4, atol=1e-5)
+    assert got.shape == (1, 5)
+    np.testing.assert_allclose(got.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_synthetic_block_batched(resnet_block_onnx):
+    path, inits = resnet_block_onnx
+    m = load_onnx_model(path, max_batch_size=4)
+    x = np.random.default_rng(4).standard_normal((3, 3, 8, 8)).astype(
+        np.float32)
+    got = np.asarray(m.apply_fn(m.params, {"x": x})["probs"])
+    np.testing.assert_allclose(got, _expected_block(inits, x),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_unsupported_op_reports_name(resnet_block_onnx):
+    data = _model_bytes([_node("NonsenseOp", ["x"], ["y"])], {},
+                        [("x", [1, 4])], [("y", [1, 4])])
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".onnx", delete=False) as f:
+        f.write(data)
+    # surfaces at import time (the shape-discovery trace hits the op)
+    with pytest.raises(NotImplementedError, match="NonsenseOp"):
+        load_onnx_model(f.name, max_batch_size=1)
+    os.unlink(f.name)
+
+
+# ------------------------------------------------- reference zoo artifact --
+needs_ref = pytest.mark.skipif(not os.path.isdir(REF_MNIST),
+                               reason="reference mnist-v1.3 not present")
+
+
+@needs_ref
+def test_mnist_parse_structure():
+    om = parse_onnx(os.path.join(REF_MNIST, "model.onnx"))
+    assert om.opset == 8
+    ops = [n.op for n in om.graph.nodes]
+    assert ops.count("Conv") == 2 and ops.count("MaxPool") == 2
+    assert "MatMul" in ops and "Reshape" in ops
+    assert om.graph.initializers["Parameter193"].shape == (16, 4, 4, 10)
+
+
+@needs_ref
+@pytest.mark.parametrize("i", [0, 1, 2])
+def test_mnist_golden_vectors(i):
+    """The reference's own acceptance flow: bundled inputs through the
+    imported graph must match bundled outputs (run_onnx_tests analog)."""
+    m = load_onnx_model(os.path.join(REF_MNIST, "model.onnx"))
+    x = load_tensor_pb(os.path.join(REF_MNIST, f"test_data_set_{i}",
+                                    "input_0.pb"))
+    want = load_tensor_pb(os.path.join(REF_MNIST, f"test_data_set_{i}",
+                                       "output_0.pb"))
+    got = np.asarray(m.apply_fn(m.params, {"Input3": x})["Plus214_Output_0"])
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+@needs_ref
+def test_mnist_served_through_engine():
+    """Imported model -> InferenceManager -> InferRunner: the full
+    'bring your model' serving path at a batch the export never saw
+    (the importer's Reshape batch-rebind under bucketed serving)."""
+    from tpulab.engine import InferenceManager
+
+    m = load_onnx_model(os.path.join(REF_MNIST, "model.onnx"),
+                        name="mnist_onnx", max_batch_size=4)
+    mgr = InferenceManager(max_executions=2)
+    mgr.register_model("mnist_onnx", m)
+    mgr.update_resources()
+    try:
+        x = load_tensor_pb(os.path.join(REF_MNIST, "test_data_set_0",
+                                        "input_0.pb"))
+        want = load_tensor_pb(os.path.join(REF_MNIST, "test_data_set_0",
+                                           "output_0.pb"))
+        x3 = np.concatenate([x, x, x], 0)
+        out = mgr.infer_runner("mnist_onnx").infer(Input3=x3).result(
+            timeout=120)
+        got = out["Plus214_Output_0"]
+        assert got.shape == (3, 10)
+        for row in got:
+            np.testing.assert_allclose(row[None], want, rtol=1e-3, atol=1e-3)
+    finally:
+        mgr.shutdown()
+
+
+@needs_ref
+def test_build_engine_cli_onnx(tmp_path):
+    """tools/build_engine.py --onnx --verify-dir: the reference's offline
+    build.py workflow (parse -> verify -> serialize engine artifact)."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_dir = tmp_path / "engine"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    proc = subprocess.run(
+        [sys.executable, "tools/build_engine.py", "--cpu",
+         "--onnx", os.path.join(REF_MNIST, "model.onnx"),
+         "--verify-dir", os.path.join(REF_MNIST, "test_data_set_0"),
+         "--max-batch", "2", "--out", str(out_dir)],
+        capture_output=True, text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "verified 1 output tensor(s)" in proc.stdout
+    assert (out_dir / "spec.json").exists()

@@ -29,6 +29,19 @@ def test_device_info():
     assert len(DeviceInfo.cpu_affinity()) >= 1
 
 
+def test_peak_flops_table():
+    # CPU backend: unknown kind -> None (MFU rows are skipped, not wrong)
+    assert DeviceInfo.peak_flops("bf16") is None
+    # table lookup order: 'v5 lite' must match before bare 'v5' (v5p)
+    kinds = {m: p for m, p in DeviceInfo._PEAK_FLOPS}
+    assert kinds["v5 lite"]["bf16"] == 197e12
+    assert kinds["v5"]["bf16"] == 459e12
+    markers = [m for m, _ in DeviceInfo._PEAK_FLOPS]
+    assert markers.index("v5 lite") < markers.index("v5")
+    # int8 generations double where the hardware does
+    assert kinds["v5 lite"]["int8"] == 2 * kinds["v5 lite"]["bf16"]
+
+
 def test_tpu_memory_types():
     assert not tt.TpuMemory.host_accessible
     assert tt.TpuMemory.access_alignment == 512

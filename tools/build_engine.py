@@ -4,11 +4,40 @@ models/onnx_builder.py: build serialized engines ahead of serving).
 
     python tools/build_engine.py --model resnet50 --uint8 --max-batch 128 \
         --out engines/rn50 [--int8] [--torch-checkpoint path.pt]
+    python tools/build_engine.py --onnx model.onnx --out engines/my_model \
+        [--verify-dir test_data_set_0]  # ONNX zoo golden vectors
 """
 
 import argparse
 import json
 import time
+
+
+def _verify_onnx(model, data_dir: str) -> None:
+    """Golden-check against ONNX zoo test vectors (reference
+    examples/ONNX mnist flow: run bundled inputs, compare outputs)."""
+    import glob
+    import os
+
+    import numpy as np
+    from tpulab.models.onnx_import import load_tensor_pb
+
+    ins = sorted(glob.glob(os.path.join(data_dir, "input_*.pb")))
+    outs = sorted(glob.glob(os.path.join(data_dir, "output_*.pb")))
+    if len(ins) != len(model.inputs) or len(outs) != len(model.outputs):
+        raise SystemExit(
+            f"--verify-dir {data_dir}: found {len(ins)} input / "
+            f"{len(outs)} output .pb files but the model has "
+            f"{len(model.inputs)} inputs / {len(model.outputs)} outputs — "
+            "refusing to claim a verification that would compare nothing")
+    feeds = {s.name: load_tensor_pb(p) for s, p in zip(model.inputs, ins)}
+    got = model.apply_fn(model.params, feeds)
+    for spec, path in zip(model.outputs, outs):
+        want = load_tensor_pb(path)
+        np.testing.assert_allclose(np.asarray(got[spec.name]), want,
+                                   rtol=1e-3, atol=1e-4)
+    print(f"# verified {len(outs)} output tensor(s) against golden "
+          f"vectors in {data_dir}")
 
 
 def main():
@@ -21,6 +50,13 @@ def main():
                     help="weight-only INT8 quantization")
     ap.add_argument("--torch-checkpoint", default=None,
                     help="import pretrained torch weights (resnet only)")
+    ap.add_argument("--onnx", default=None,
+                    help="import an ONNX model file (conv/bn/gemm/pool/"
+                         "softmax-class graphs; the reference's model-entry "
+                         "path, examples/ONNX/resnet50/build.py)")
+    ap.add_argument("--verify-dir", default=None,
+                    help="ONNX zoo test_data_set dir: run input_*.pb "
+                         "through the imported model and check output_*.pb")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
@@ -36,7 +72,12 @@ def main():
     kwargs = dict(max_batch_size=args.max_batch)
     if args.uint8 and args.model.startswith("resnet"):
         kwargs["input_dtype"] = np.uint8
-    if args.torch_checkpoint:
+    if args.onnx:
+        from tpulab.models.onnx_import import load_onnx_model
+        model = load_onnx_model(args.onnx, max_batch_size=args.max_batch)
+        if args.verify_dir:
+            _verify_onnx(model, args.verify_dir)
+    elif args.torch_checkpoint:
         if not args.model.startswith("resnet"):
             ap.error("--torch-checkpoint supports resnet models only")
         from tpulab.models.torch_import import make_resnet_from_torch
@@ -46,7 +87,7 @@ def main():
     else:
         model = build_model(args.model, **kwargs)
     if args.int8:
-        if not args.model.startswith("resnet"):
+        if args.onnx or not args.model.startswith("resnet"):
             ap.error("--int8 quantization supports resnet models only")
         from tpulab.models.quantization import quantize_resnet_params
         model.params = quantize_resnet_params(model.params)

@@ -73,8 +73,12 @@ def main() -> int:
             print("[hw_validate] suite timed out; retrying later",
                   flush=True)
             # evidence even on a hang -- but never clobber a green run
-            if not (os.path.exists(out_path)
-                    and "(rc=0)" in open(out_path).read(100)):
+            # (the rc marker lives on the header line by construction)
+            head = ""
+            if os.path.exists(out_path):
+                with open(out_path) as f:
+                    head = f.readline()
+            if "(rc=0)" not in head:
                 stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
                 with open(out_path, "w") as f:
                     f.write(f"# hardware suite TIMED OUT at {stamp}\n")

@@ -1370,7 +1370,11 @@ def benchmark_decode_kernel_vs_gather(n_heads: int = 8, n_layers: int = 4,
     row["gather_tok_s"], err = timed(False)
     if err:
         row["gather_error"] = err
-    g0, n0 = _block_geometry(page_size, mp, n_heads * (d_model // n_heads),
+    # the kernel's internal auto-pick is hkv*d (paged_attention.py); this
+    # model is MHA so hkv == n_heads, but derive it the same way so the
+    # recorded geometry stays honest if a GQA variant joins the sweep
+    hkv = n_heads  # init_transformer_params above builds an MHA model
+    g0, n0 = _block_geometry(page_size, mp, hkv * (d_model // n_heads),
                              jnp.dtype(dtype).itemsize)
     row["kernel_geom"] = f"g{g0}xn{n0}"
     if autotune and "kernel_error" not in row:

@@ -72,6 +72,20 @@ class CompiledModel:
         return int(getattr(ma, "temp_size_in_bytes", 0) +
                    getattr(ma, "output_size_in_bytes", 0))
 
+    def flops(self, bucket: Optional[int] = None) -> Optional[float]:
+        """XLA cost-analysis FLOPs of one bucket's executable (the whole
+        batch, not per-row) — the MFU numerator.  None when the backend
+        doesn't report cost analysis."""
+        b = bucket or self.model.batch_buckets[-1]
+        try:
+            ca = self.executables[b].cost_analysis()
+            if isinstance(ca, (list, tuple)):  # older jax: one per device
+                ca = ca[0]
+            f = float(ca["flops"])
+            return f if f > 0 else None
+        except Exception:
+            return None
+
     def __call__(self, bucket: int, inputs: Dict[str, Any]) -> Dict[str, Any]:
         return self.executables[bucket](self.device_params, inputs)
 

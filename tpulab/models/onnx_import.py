@@ -1,0 +1,696 @@
+"""ONNX model import: ``model.onnx`` -> servable :class:`tpulab.engine.Model`.
+
+The reference's model-entry path is ONNX (examples/ONNX/resnet50/build.py:33-70
+parses an ONNX graph into a TensorRT network; models/onnx/onnx_builder.py packages
+it).  tpulab's analog maps the ONNX graph onto a pure JAX function — XLA then
+owns fusion/layout (no hand-built network): every op below lowers to jax/lax
+primitives, traced once per batch bucket and compiled AOT by the engine layer.
+
+Self-contained by design: the ``onnx`` python package is not a dependency.
+ONNX files are protobuf; this module carries a ~100-line protobuf *wire-format*
+reader plus the (stable, versioned) ONNX field numbers for the handful of
+messages an importer needs — ModelProto/GraphProto/NodeProto/TensorProto/
+ValueInfoProto.  The same reader parses the ``test_data_set_*/{input,output}_N.pb``
+TensorProto vectors the ONNX model zoo bundles (reference
+models/onnx/mnist-v1.3/test_data_set_*), which golden-check the import.
+
+Layout note (TPU-first): ONNX graphs are NCHW.  The importer executes them
+as-written with explicit NCHW dimension numbers rather than rewriting to NHWC —
+XLA's layout assignment owns the physical tiling on TPU, and a mechanical
+NHWC rewrite would have to chase every Reshape/Flatten through the graph for
+no compiler-visible gain.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# protobuf wire-format reader (varint / 64-bit / length-delimited / 32-bit)
+# --------------------------------------------------------------------------
+
+
+def _varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = result = 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("malformed varint")
+
+
+def _fields(buf: bytes) -> List[Tuple[int, int, Any]]:
+    """Decode one message's fields -> [(field_no, wire_type, raw_value)].
+    Length-delimited values stay ``bytes`` (sub-message, string, or packed
+    repeated — the schema layer decides)."""
+    out = []
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 1:
+            v = struct.unpack_from("<Q", buf, i)[0]
+            i += 8
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = struct.unpack_from("<I", buf, i)[0]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        out.append((fno, wt, v))
+    return out
+
+
+def _group(buf: bytes) -> Dict[int, List[Tuple[int, Any]]]:
+    g: Dict[int, List[Tuple[int, Any]]] = {}
+    for fno, wt, v in _fields(buf):
+        g.setdefault(fno, []).append((wt, v))
+    return g
+
+
+def _packed_varints(entries: List[Tuple[int, Any]]) -> List[int]:
+    """Repeated int field: packed (length-delimited) and/or unpacked."""
+    out: List[int] = []
+    for wt, v in entries:
+        if wt == 2:
+            i = 0
+            while i < len(v):
+                x, i = _varint(v, i)
+                out.append(x)
+        else:
+            out.append(v)
+    return out
+
+
+def _zigzag_signed(x: int, bits: int = 64) -> int:
+    """Plain (non-zigzag) two's-complement signed varint, as int64/32
+    protobuf fields use."""
+    if x >= 1 << (bits - 1):
+        x -= 1 << bits
+    return x
+
+
+# --------------------------------------------------------------------------
+# ONNX schema (field numbers from onnx/onnx.proto — stable since IR v3)
+# --------------------------------------------------------------------------
+
+# TensorProto.DataType -> numpy
+_DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
+           6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16,
+           11: np.float64, 12: np.uint32, 13: np.uint64}
+
+
+def _decode_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+    """TensorProto -> (name, ndarray).  Fields: dims=1 data_type=2
+    float_data=4 int32_data=5 string_data=6 int64_data=7 name=8 raw_data=9
+    double_data=10 uint64_data=11."""
+    g = _group(buf)
+    dims = _packed_varints(g.get(1, []))
+    dt = _packed_varints(g.get(2, []))
+    dtype = np.dtype(_DTYPES[dt[0] if dt else 1])
+    name = g[8][0][1].decode() if 8 in g else ""
+    if 9 in g:  # raw_data: little-endian, C order (the common zoo encoding)
+        raw = b"".join(v for _, v in g[9])
+        arr = np.frombuffer(raw, dtype=dtype)
+    elif 4 in g:  # float_data (packed fixed32 or unpacked)
+        vals: List[float] = []
+        for wt, v in g[4]:
+            if wt == 2:
+                vals.extend(struct.unpack(f"<{len(v) // 4}f", v))
+            else:
+                vals.append(struct.unpack("<f", struct.pack("<I", v))[0])
+        arr = np.asarray(vals, np.float32).astype(dtype)
+    elif 7 in g:  # int64_data
+        arr = np.asarray([_zigzag_signed(x) for x in _packed_varints(g[7])],
+                         np.int64).astype(dtype)
+    elif 5 in g:  # int32_data (also carries f16/i8/u8/i16/u16/bool payloads)
+        # negative int32 still serializes as 64-bit two's complement
+        ints = [_zigzag_signed(x) for x in _packed_varints(g[5])]
+        if dtype == np.float16:
+            arr = np.asarray(ints, np.uint16).view(np.float16)
+        else:
+            arr = np.asarray(ints, np.int32).astype(dtype)
+    elif 10 in g:  # double_data
+        vals = []
+        for wt, v in g[10]:
+            if wt == 2:
+                vals.extend(struct.unpack(f"<{len(v) // 8}d", v))
+            else:
+                vals.append(struct.unpack("<d", struct.pack("<Q", v))[0])
+        arr = np.asarray(vals, np.float64).astype(dtype)
+    elif 11 in g:  # uint64_data
+        arr = np.asarray(_packed_varints(g[11]), np.uint64).astype(dtype)
+    else:
+        arr = np.zeros(0, dtype)
+    return name, arr.reshape(dims) if dims else arr
+
+
+def _decode_attr(buf: bytes) -> Tuple[str, Any]:
+    """AttributeProto: name=1 f=2 i=3 s=4 t=5 g=6 floats=7 ints=8
+    strings=9 (type=20 is redundant with which field is set)."""
+    g = _group(buf)
+    name = g[1][0][1].decode()
+    if 2 in g:
+        return name, struct.unpack("<f", struct.pack("<I", g[2][0][1]))[0]
+    if 3 in g:
+        return name, _zigzag_signed(g[3][0][1])
+    if 4 in g:
+        return name, g[4][0][1]  # bytes
+    if 5 in g:
+        return name, _decode_tensor(g[5][0][1])[1]
+    if 7 in g:
+        vals = []
+        for wt, v in g[7]:
+            if wt == 2:
+                vals.extend(struct.unpack(f"<{len(v) // 4}f", v))
+            else:
+                vals.append(struct.unpack("<f", struct.pack("<I", v))[0])
+        return name, vals
+    if 8 in g:
+        return name, [_zigzag_signed(x) for x in _packed_varints(g[8])]
+    if 9 in g:
+        return name, [v for _, v in g[9]]
+    if 6 in g:
+        raise NotImplementedError("graph-valued attributes (If/Loop/Scan) "
+                                  "are outside the importer's static scope")
+    return name, None
+
+
+class OnnxNode:
+    __slots__ = ("op", "name", "inputs", "outputs", "attrs")
+
+    def __init__(self, buf: bytes):
+        g = _group(buf)  # input=1 output=2 name=3 op_type=4 attribute=5
+        self.inputs = [v.decode() for _, v in g.get(1, [])]
+        self.outputs = [v.decode() for _, v in g.get(2, [])]
+        self.name = g[3][0][1].decode() if 3 in g else ""
+        self.op = g[4][0][1].decode() if 4 in g else ""
+        self.attrs = dict(_decode_attr(v) for _, v in g.get(5, []))
+
+
+def _decode_value_info(buf: bytes) -> Tuple[str, Optional[np.dtype],
+                                            List[Optional[int]]]:
+    """ValueInfoProto -> (name, dtype, dims) with None for symbolic dims.
+    name=1 type=2; TypeProto.tensor_type=1; Tensor.elem_type=1 shape=2;
+    TensorShapeProto.dim=1; Dimension.dim_value=1 dim_param=2."""
+    g = _group(buf)
+    name = g[1][0][1].decode()
+    dtype, dims = None, []
+    if 2 in g:
+        tp = _group(g[2][0][1])
+        if 1 in tp:
+            tt = _group(tp[1][0][1])
+            if 1 in tt:
+                dtype = np.dtype(_DTYPES.get(tt[1][0][1], np.float32))
+            if 2 in tt:
+                for _, dim_buf in _group(tt[2][0][1]).get(1, []):
+                    d = _group(dim_buf)
+                    dims.append(d[1][0][1] if 1 in d else None)
+    return name, dtype, dims
+
+
+class OnnxGraph:
+    """Parsed GraphProto: node=1 name=2 initializer=5 input=11 output=12."""
+
+    def __init__(self, buf: bytes):
+        g = _group(buf)
+        self.name = g[2][0][1].decode() if 2 in g else "onnx"
+        self.nodes = [OnnxNode(v) for _, v in g.get(1, [])]
+        self.initializers: Dict[str, np.ndarray] = dict(
+            _decode_tensor(v) for _, v in g.get(5, []))
+        self.inputs = [_decode_value_info(v) for _, v in g.get(11, [])]
+        self.outputs = [_decode_value_info(v) for _, v in g.get(12, [])]
+
+
+class OnnxModel:
+    """Parsed ModelProto: ir_version=1 producer_name=2 graph=7
+    opset_import=8 (OperatorSetIdProto: domain=1 version=2)."""
+
+    def __init__(self, data: bytes):
+        g = _group(data)
+        self.ir_version = g[1][0][1] if 1 in g else 0
+        self.producer = g[2][0][1].decode() if 2 in g else ""
+        self.opset = 1
+        for _, v in g.get(8, []):
+            os_g = _group(v)
+            domain = os_g[1][0][1].decode() if 1 in os_g else ""
+            if domain in ("", "ai.onnx") and 2 in os_g:
+                self.opset = max(self.opset, os_g[2][0][1])
+        if 7 not in g:
+            raise ValueError("ModelProto has no graph")
+        self.graph = OnnxGraph(g[7][0][1])
+
+
+def load_tensor_pb(path: str) -> np.ndarray:
+    """A bare serialized TensorProto (the zoo's test_data_set vectors)."""
+    with open(path, "rb") as f:
+        return _decode_tensor(f.read())[1]
+
+
+# --------------------------------------------------------------------------
+# ONNX graph -> JAX function
+# --------------------------------------------------------------------------
+
+
+def _pair_pads(pads: Sequence[int], nd: int) -> List[Tuple[int, int]]:
+    """ONNX pads [x1_b, x2_b, ..., x1_e, x2_e, ...] -> [(b, e), ...]."""
+    return [(int(pads[i]), int(pads[i + nd])) for i in range(nd)]
+
+
+class _Converter:
+    """Evaluates the (topologically sorted, per ONNX spec) node list under
+    JAX tracing.  Initializers live in the params pytree; shape-carrying
+    inputs (Reshape targets, Pad amounts, ...) read the static numpy copy
+    so traced code keeps static shapes (XLA requirement)."""
+
+    def __init__(self, model: OnnxModel):
+        self.model = model
+        self.g = model.graph
+        self.opset = model.opset
+        self.static: Dict[str, np.ndarray] = dict(self.g.initializers)
+
+    # -- static (host) values ------------------------------------------------
+    def _static_val(self, name: str) -> np.ndarray:
+        if name not in self.static:
+            raise NotImplementedError(
+                f"input {name!r} must be a static initializer/Constant "
+                "(data-dependent shapes cannot compile to static XLA shapes)")
+        return self.static[name]
+
+    def prefold_constants(self) -> None:
+        """Constant nodes join the static pool (and params) up front."""
+        for node in self.g.nodes:
+            if node.op == "Constant":
+                val = node.attrs.get("value")
+                if val is None:
+                    raise NotImplementedError("Constant without 'value'")
+                self.static[node.outputs[0]] = np.asarray(val)
+
+    # -- the traced evaluator ------------------------------------------------
+    def build(self) -> Tuple[Callable, Dict[str, np.ndarray],
+                             List[str], List[str]]:
+        import jax.numpy as jnp  # noqa: F401  (ops close over jnp/lax)
+
+        self.prefold_constants()
+        graph_inputs = [n for n, _, _ in self.g.inputs
+                        if n not in self.static]
+        out_names = [n for n, _, _ in self.g.outputs]
+        params = {k: v for k, v in self.static.items()}
+        nodes = self.g.nodes
+
+        def apply_fn(p: Dict[str, Any], inputs: Dict[str, Any]
+                     ) -> Dict[str, Any]:
+            env: Dict[str, Any] = dict(p)
+            env.update(inputs)
+            for node in nodes:
+                if node.op == "Constant":
+                    env[node.outputs[0]] = jnp.asarray(
+                        self.static[node.outputs[0]])
+                    continue
+                fn = _OPS.get(node.op)
+                if fn is None:
+                    raise NotImplementedError(
+                        f"ONNX op {node.op!r} (node {node.name!r}) is not "
+                        "supported by the importer")
+                args = [env[i] if i else None for i in node.inputs]
+                res = fn(self, node, args)
+                if not isinstance(res, tuple):
+                    res = (res,)
+                for out_name, val in zip(node.outputs, res):
+                    if out_name:
+                        env[out_name] = val
+            return {n: env[n] for n in out_names}
+
+        return apply_fn, params, graph_inputs, out_names
+
+
+# op implementations -- each: (conv: _Converter, node, args) -> array | tuple
+_OPS: Dict[str, Callable] = {}
+
+
+def _op(name: str):
+    def deco(fn):
+        _OPS[name] = fn
+        return fn
+    return deco
+
+
+def _conv_padding(node: OnnxNode, nd: int):
+    auto = node.attrs.get("auto_pad", b"NOTSET").decode() \
+        if isinstance(node.attrs.get("auto_pad"), bytes) \
+        else (node.attrs.get("auto_pad") or "NOTSET")
+    if auto in ("NOTSET", ""):
+        return _pair_pads(node.attrs.get("pads", [0] * 2 * nd), nd)
+    if auto == "VALID":
+        return [(0, 0)] * nd
+    if auto == "SAME_UPPER":
+        return "SAME"
+    raise NotImplementedError(f"auto_pad={auto}")
+
+
+@_op("Conv")
+def _conv(conv, node, args):
+    from jax import lax
+    x, w = args[0], args[1]
+    nd = x.ndim - 2
+    spatial = "".join("DHW"[3 - nd:])
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape, (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
+    out = lax.conv_general_dilated(
+        x, w,
+        window_strides=[int(s) for s in node.attrs.get("strides", [1] * nd)],
+        padding=_conv_padding(node, nd),
+        rhs_dilation=[int(d) for d in node.attrs.get("dilations", [1] * nd)],
+        dimension_numbers=dn,
+        feature_group_count=int(node.attrs.get("group", 1)))
+    if len(args) > 2 and args[2] is not None:
+        out = out + args[2].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@_op("Relu")
+def _relu(conv, node, args):
+    import jax.numpy as jnp
+    return jnp.maximum(args[0], 0)
+
+
+@_op("Sigmoid")
+def _sigmoid(conv, node, args):
+    import jax
+    return jax.nn.sigmoid(args[0])
+
+
+@_op("Tanh")
+def _tanh(conv, node, args):
+    import jax.numpy as jnp
+    return jnp.tanh(args[0])
+
+
+@_op("LeakyRelu")
+def _leaky(conv, node, args):
+    import jax
+    return jax.nn.leaky_relu(args[0], node.attrs.get("alpha", 0.01))
+
+
+@_op("Clip")
+def _clip(conv, node, args):
+    import jax.numpy as jnp
+    lo = node.attrs.get("min")
+    hi = node.attrs.get("max")
+    if len(args) > 1 and args[1] is not None:   # opset 11+: min/max inputs
+        lo = conv._static_val(conv_input_name(node, 1))
+    if len(args) > 2 and args[2] is not None:
+        hi = conv._static_val(conv_input_name(node, 2))
+    return jnp.clip(args[0], lo, hi)
+
+
+def conv_input_name(node: OnnxNode, i: int) -> str:
+    return node.inputs[i]
+
+
+def _pool(conv, node, args, reducer, init, is_avg: bool):
+    from jax import lax
+    import jax.numpy as jnp
+    x = args[0]
+    nd = x.ndim - 2
+    if int(node.attrs.get("ceil_mode", 0)):
+        raise NotImplementedError("ceil_mode pooling")
+    ks = [int(k) for k in node.attrs["kernel_shape"]]
+    strides = [int(s) for s in node.attrs.get("strides", [1] * nd)]
+    pads = _conv_padding(node, nd)
+    window = (1, 1, *ks)
+    strides_full = (1, 1, *strides)
+    pad_full = ([(0, 0), (0, 0), *pads] if isinstance(pads, list) else pads)
+    if not is_avg:
+        return lax.reduce_window(x, init, reducer, window, strides_full,
+                                 pad_full)
+    s = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, window,
+                          strides_full, pad_full)
+    if int(node.attrs.get("count_include_pad", 0)):
+        denom = float(math.prod(ks))
+        return (s / denom).astype(x.dtype)
+    # count_include_pad=0 (the default): edge windows divide by the
+    # number of UNPADDED elements — counted with a ones reduce_window,
+    # which handles explicit pads and "SAME" alike
+    ones = jnp.ones(x.shape, jnp.float32)
+    counts = lax.reduce_window(ones, 0.0, lax.add, window, strides_full,
+                               pad_full)
+    return (s / counts).astype(x.dtype)
+
+
+@_op("MaxPool")
+def _maxpool(conv, node, args):
+    from jax import lax
+    return _pool(conv, node, args, lax.max, -np.inf, False)
+
+
+@_op("AveragePool")
+def _avgpool(conv, node, args):
+    return _pool(conv, node, args, None, None, True)
+
+
+@_op("GlobalAveragePool")
+def _gap(conv, node, args):
+    import jax.numpy as jnp
+    x = args[0]
+    return jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@_op("GlobalMaxPool")
+def _gmp(conv, node, args):
+    import jax.numpy as jnp
+    x = args[0]
+    return jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+
+
+@_op("BatchNormalization")
+def _bn(conv, node, args):
+    import jax.numpy as jnp
+    x, scale, bias, mean, var = args[:5]
+    eps = node.attrs.get("epsilon", 1e-5)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    inv = jnp.asarray(scale) / jnp.sqrt(jnp.asarray(var) + eps)
+    return x * inv.reshape(shape) + (
+        jnp.asarray(bias) - jnp.asarray(mean) * inv).reshape(shape)
+
+
+for _name, _sym in (("Add", "add"), ("Sub", "subtract"), ("Mul", "multiply"),
+                    ("Div", "divide"), ("Pow", "power")):
+    def _binop(conv, node, args, _sym=_sym):
+        import jax.numpy as jnp
+        return getattr(jnp, _sym)(args[0], args[1])
+    _OPS[_name] = _binop
+
+
+@_op("Sum")
+def _sum(conv, node, args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@_op("MatMul")
+def _matmul(conv, node, args):
+    import jax.numpy as jnp
+    return jnp.matmul(args[0], args[1])
+
+
+@_op("Gemm")
+def _gemm(conv, node, args):
+    import jax.numpy as jnp
+    a, b = args[0], args[1]
+    if int(node.attrs.get("transA", 0)):
+        a = a.T
+    if int(node.attrs.get("transB", 0)):
+        b = b.T
+    out = node.attrs.get("alpha", 1.0) * (a @ b)
+    if len(args) > 2 and args[2] is not None:
+        out = out + node.attrs.get("beta", 1.0) * args[2]
+    return out
+
+
+@_op("Reshape")
+def _reshape(conv, node, args):
+    x = args[0]
+    if len(node.inputs) > 1:                      # opset 5+: shape input
+        target = [int(d) for d in conv._static_val(node.inputs[1])]
+    else:
+        target = [int(d) for d in node.attrs["shape"]]
+    # ONNX 0 = copy input dim (allowzero=0 default)
+    target = [int(x.shape[i]) if d == 0 else d for i, d in enumerate(target)]
+    # batch-bucket serving: a fixed leading dim baked at export batch (the
+    # zoo exports at N=1) re-binds to the runtime batch when that is the
+    # only way the element counts reconcile
+    if -1 not in target and math.prod(target) != math.prod(x.shape):
+        rebind = [int(x.shape[0])] + target[1:]
+        if math.prod(rebind) == math.prod(x.shape):
+            target = rebind
+        else:
+            raise ValueError(f"Reshape {node.name!r}: {x.shape} -> {target}")
+    return x.reshape(target)
+
+
+@_op("Flatten")
+def _flatten(conv, node, args):
+    x = args[0]
+    ax = int(node.attrs.get("axis", 1))
+    return x.reshape((int(math.prod(x.shape[:ax])), -1))
+
+
+@_op("Softmax")
+def _softmax(conv, node, args):
+    import jax
+    x = args[0]
+    if conv.opset >= 13:
+        return jax.nn.softmax(x, axis=int(node.attrs.get("axis", -1)))
+    # opset <13: coerce to 2D at `axis`, softmax the trailing block
+    ax = int(node.attrs.get("axis", 1))
+    two_d = x.reshape((int(math.prod(x.shape[:ax])), -1))
+    return jax.nn.softmax(two_d, axis=1).reshape(x.shape)
+
+
+@_op("Concat")
+def _concat(conv, node, args):
+    import jax.numpy as jnp
+    return jnp.concatenate(args, axis=int(node.attrs["axis"]))
+
+
+@_op("Transpose")
+def _transpose(conv, node, args):
+    import jax.numpy as jnp
+    perm = node.attrs.get("perm")
+    return jnp.transpose(args[0], perm)
+
+
+@_op("Identity")
+def _identity(conv, node, args):
+    return args[0]
+
+
+@_op("Dropout")
+def _dropout(conv, node, args):
+    import jax.numpy as jnp
+    x = args[0]
+    if len(node.outputs) > 1:  # inference mask output: all-true
+        return x, jnp.ones(x.shape, np.bool_)
+    return x
+
+
+@_op("Cast")
+def _cast(conv, node, args):
+    return args[0].astype(np.dtype(_DTYPES[int(node.attrs["to"])]))
+
+
+@_op("Pad")
+def _pad(conv, node, args):
+    import jax.numpy as jnp
+    x = args[0]
+    mode = node.attrs.get("mode", b"constant")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    if len(node.inputs) > 1:                      # opset 11+: pads input
+        pads = [int(p) for p in conv._static_val(node.inputs[1])]
+        cval = (float(conv._static_val(node.inputs[2]))
+                if len(node.inputs) > 2 and node.inputs[2] else 0.0)
+    else:
+        pads = [int(p) for p in node.attrs["pads"]]
+        cval = node.attrs.get("value", 0.0)
+    pairs = _pair_pads(pads, x.ndim)
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=cval)
+    return jnp.pad(x, pairs, mode={"reflect": "reflect",
+                                   "edge": "edge"}[mode])
+
+
+@_op("ReduceMean")
+def _reduce_mean(conv, node, args):
+    import jax.numpy as jnp
+    axes = node.attrs.get("axes")
+    if axes is None and len(node.inputs) > 1:
+        axes = [int(a) for a in conv._static_val(node.inputs[1])]
+    return jnp.mean(args[0], axis=tuple(axes) if axes else None,
+                    keepdims=bool(node.attrs.get("keepdims", 1)))
+
+
+@_op("Squeeze")
+def _squeeze(conv, node, args):
+    import jax.numpy as jnp
+    axes = node.attrs.get("axes")
+    if axes is None and len(node.inputs) > 1:
+        axes = [int(a) for a in conv._static_val(node.inputs[1])]
+    return jnp.squeeze(args[0], axis=tuple(axes) if axes else None)
+
+
+@_op("Unsqueeze")
+def _unsqueeze(conv, node, args):
+    import jax.numpy as jnp
+    axes = node.attrs.get("axes")
+    if axes is None and len(node.inputs) > 1:
+        axes = [int(a) for a in conv._static_val(node.inputs[1])]
+    return jnp.expand_dims(args[0], tuple(int(a) for a in axes))
+
+
+# --------------------------------------------------------------------------
+# public entry points
+# --------------------------------------------------------------------------
+
+
+def parse_onnx(path: str) -> OnnxModel:
+    with open(path, "rb") as f:
+        return OnnxModel(f.read())
+
+
+def load_onnx_model(path: str, name: Optional[str] = None,
+                    max_batch_size: int = 8,
+                    batch_buckets: Optional[Sequence[int]] = None):
+    """``model.onnx`` -> servable :class:`~tpulab.engine.model.Model`.
+
+    The ONNX graph's leading input dim is the batch axis (symbolic or the
+    zoo's exported N=1); IOSpecs strip it and the engine layer re-batches
+    per bucket (its static-shape 'optimization profiles').  Mirrors
+    reference examples/ONNX/resnet50/build.py:33-70 (parser -> network ->
+    engine) with XLA as the builder.
+    """
+    from tpulab.engine.model import IOSpec, Model
+
+    om = parse_onnx(path)
+    apply_fn, params, in_names, out_names = _Converter(om).build()
+
+    in_specs = []
+    info = {n: (dt, dims) for n, dt, dims in om.graph.inputs}
+    for n in in_names:
+        dt, dims = info[n]
+        if len(dims) < 1:
+            raise ValueError(f"input {n!r} has no shape")
+        if any(d is None for d in dims[1:]):
+            raise NotImplementedError(
+                f"input {n!r} has symbolic non-batch dims {dims}: XLA "
+                "serves static shapes (pick a size and re-export)")
+        in_specs.append(IOSpec(n, tuple(int(d) for d in dims[1:]),
+                               dt or np.float32))
+
+    # trace once at batch=1 to discover output shapes (cheap: abstract eval)
+    import jax
+    import jax.numpy as jnp
+    sample = {s.name: jnp.zeros((1, *s.shape), s.np_dtype)
+              for s in in_specs}
+    out_shapes = jax.eval_shape(apply_fn, params, sample)
+    out_specs = [IOSpec(n, tuple(int(d) for d in out_shapes[n].shape[1:]),
+                        out_shapes[n].dtype) for n in out_names]
+
+    return Model(name or om.graph.name or "onnx", apply_fn, params,
+                 in_specs, out_specs, max_batch_size=max_batch_size,
+                 batch_buckets=batch_buckets)

@@ -306,6 +306,12 @@ class StreamInferContext(StreamingContext):
             # registered BEFORE the worker starts: run()'s prune always
             # finds the entry, so nothing can leak (drain polls emptiness)
             self._inflight[seq] = True
+        # counted from registration through write+prune: the manager-level
+        # drain() must cover the queued-not-yet-started and computed-but-
+        # not-yet-written windows too, not just the inner execute_rpc span
+        # (the inner InferContext counts again while computing — nested
+        # +1/-1 is harmless for a drain that waits for zero)
+        res.request_started()
 
         def run():
             try:
@@ -323,12 +329,14 @@ class StreamInferContext(StreamingContext):
             finally:
                 with self._lock:
                     self._inflight.pop(seq, None)
+                res.request_finished()
 
         try:
             res.manager.workers("pre").enqueue(run)
         except BaseException:  # enqueue failed: prune or the drain spins
             with self._lock:
                 self._inflight.pop(seq, None)
+            res.request_finished()
             raise
 
     def _busy(self) -> bool:

@@ -62,6 +62,38 @@ class DeviceInfo:
             stats.get("peak_bytes_in_use"),
         )
 
+    # Public per-chip peak dense-matmul throughput (FLOP/s), keyed by
+    # PjRt device_kind substring.  Sources: cloud.google.com/tpu/docs
+    # system-architecture tables (bf16 peak; int8 where the generation
+    # has an int8 MXU mode).  The reference exposes NVML power/clocks
+    # (device_info.cc) — libtpu exposes no power/duty-cycle query via
+    # PjRt, so the compute-capability table + HBM stats are the TPU
+    # telemetry surface (see docs/PARITY.md).
+    _PEAK_FLOPS = (
+        ("v6", {"bf16": 918e12, "int8": 1836e12}),
+        ("v5 lite", {"bf16": 197e12, "int8": 394e12}),
+        ("v5e", {"bf16": 197e12, "int8": 394e12}),
+        ("v5", {"bf16": 459e12, "int8": 918e12}),   # v5p (after lite/e)
+        ("v4", {"bf16": 275e12, "int8": 275e12}),
+        ("v3", {"bf16": 123e12, "int8": 123e12}),
+        ("v2", {"bf16": 46e12, "int8": 46e12}),
+    )
+
+    @staticmethod
+    def peak_flops(dtype: str = "bf16", index: int = 0) -> Optional[float]:
+        """Per-chip peak FLOP/s for ``dtype`` ('bf16'|'int8'), or None
+        when the device kind is unknown (e.g. CPU backends) — the MFU
+        denominator (fp32 matmuls route through the MXU at bf16-class
+        rates under XLA's default precision, so bf16 is the honest
+        denominator for fp32 models too)."""
+        kind = DeviceInfo.device_kind(index).lower()
+        if "tpu" not in kind:
+            return None
+        for marker, peaks in DeviceInfo._PEAK_FLOPS:
+            if marker in kind:
+                return peaks.get(dtype, peaks["bf16"])
+        return None
+
     @staticmethod
     def alignment() -> int:
         """Minimum device allocation alignment (reference DeviceInfo::Alignment).
