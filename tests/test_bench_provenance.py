@@ -108,6 +108,25 @@ def test_emit_keeps_live_headline_and_attaches_last_good(repo, monkeypatch,
     assert "LIVE degraded" in line["device"]
 
 
+def test_emit_degraded_attaches_cpu_trend(repo, monkeypatch, capsys):
+    """VERDICT r4 weak #5: degraded runs compare against the previous
+    round's degraded value (the only consistently available signal),
+    unwrapping the driver's {parsed: ...} wrapper."""
+    _write(str(repo / "BENCH_r04.json"),
+           {"n": 4, "rc": 0, "parsed": {
+               "value": 5.9, "device": "cpu (DEGRADED: canary failed)"}})
+    monkeypatch.delenv("TPULAB_BENCH_NO_CARRY", raising=False)
+    monkeypatch.delenv("TPULAB_BENCH_CPU_FULL", raising=False)
+    monkeypatch.setattr(bench, "_state", {
+        "done": True, "phase": "emit", "device": "cpu", "degraded": True,
+        "details": {"b1_inf_s": 5.5}})
+    bench._emit_line()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    tr = line["cpu_trend"]
+    assert tr["prev_cpu_value"] == 5.9 and tr["prev_round"] == 4
+    assert tr["delta_pct"] == round(100 * (5.5 - 5.9) / 5.9, 1)
+
+
 def test_emit_on_device_saves_last_good(repo, monkeypatch, capsys):
     monkeypatch.setenv("TPULAB_BENCH_ROUND", "4")
     monkeypatch.setattr(bench, "_state", {

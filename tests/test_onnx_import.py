@@ -177,6 +177,27 @@ def test_synthetic_block_batched(resnet_block_onnx):
                                rtol=1e-4, atol=1e-5)
 
 
+def test_reshape_batch_rebind_variants(tmp_path):
+    """Baked export-batch leading dims rebind to the runtime batch in BOTH
+    reshape idioms — [1, F] (counts reconcile only via rebind) and
+    [1, -1] (the -1 would silently merge batch rows without it) — while a
+    genuine flatten target [-1, F] stays untouched."""
+    for tag, target, want_shape in (
+            ("fixed", [1, 12], (3, 12)),
+            ("minus1", [1, -1], (3, 12)),
+            ("flatten", [-1, 4], (9, 4))):
+        inits = {"shape": np.asarray(target, np.int64)}
+        nodes = [_node("Reshape", ["x", "shape"], ["y"])]
+        p = tmp_path / f"reshape_{tag}.onnx"
+        p.write_bytes(_model_bytes(nodes, inits, [("x", [1, 3, 4])],
+                                   [("y", list(want_shape))]))
+        m = load_onnx_model(str(p), max_batch_size=4)
+        x = np.arange(3 * 3 * 4, dtype=np.float32).reshape(3, 3, 4)
+        got = np.asarray(m.apply_fn(m.params, {"x": x})["y"])
+        assert got.shape == want_shape, (tag, got.shape)
+        np.testing.assert_array_equal(got.ravel(), x.ravel())
+
+
 def test_unsupported_op_reports_name(resnet_block_onnx):
     data = _model_bytes([_node("NonsenseOp", ["x"], ["y"])], {},
                         [("x", [1, 4])], [("y", [1, 4])])
@@ -187,6 +208,210 @@ def test_unsupported_op_reports_name(resnet_block_onnx):
     with pytest.raises(NotImplementedError, match="NonsenseOp"):
         load_onnx_model(f.name, max_batch_size=1)
     os.unlink(f.name)
+
+
+# ------------------------------------- full resnet50 topology cross-check --
+def test_resnet50_topology_vs_native(tmp_path):
+    """A full ResNet-50 graph (53 conv+BN units, v1.5 strides, residual
+    adds, GAP -> Gemm) synthesized as ONNX and imported, cross-checked
+    against tpulab's native NHWC ResNet with the SAME weights (BN folded
+    by `torch_import`'s rule).  Two independent implementations —
+    NCHW/OIHW ONNX import vs NHWC/HWIO flax — agreeing end-to-end
+    validates the importer at the reference's flagship scale
+    (examples/ONNX/resnet50/build.py's model).  All convs use
+    auto_pad=SAME_UPPER so both sides share one padding rule (torch-style
+    symmetric explicit pads differ from XLA SAME at stride 2 by design).
+    """
+    import jax.numpy as jnp
+
+    from tpulab.models.resnet import STAGE_SIZES, make_resnet
+
+    rng = np.random.default_rng(11)
+    nodes, inits = [], {}
+    classes, img = 10, 64
+
+    def conv_bn(x_name, name, cin, cout, k, stride, relu):
+        w = (rng.standard_normal((cout, cin, k, k)) *
+             np.sqrt(2.0 / (cin * k * k))).astype(np.float32)
+        gamma = (0.5 + rng.random(cout)).astype(np.float32)
+        beta = rng.standard_normal(cout).astype(np.float32)
+        mean = rng.standard_normal(cout).astype(np.float32)
+        var = (0.5 + rng.random(cout)).astype(np.float32)
+        inits.update({f"{name}_w": w, f"{name}_g": gamma, f"{name}_b": beta,
+                      f"{name}_m": mean, f"{name}_v": var})
+        nodes.append(_node("Conv", [x_name, f"{name}_w"], [f"{name}_c"],
+                           kernel_shape=[k, k], strides=[stride, stride],
+                           auto_pad=b"SAME_UPPER"))
+        nodes.append(_node("BatchNormalization",
+                           [f"{name}_c", f"{name}_g", f"{name}_b",
+                            f"{name}_m", f"{name}_v"],
+                           [f"{name}_bn"], epsilon=1e-5))
+        out = f"{name}_bn"
+        if relu:
+            nodes.append(_node("Relu", [out], [f"{name}_r"]))
+            out = f"{name}_r"
+        # the native twin: folded conv+scale+bias, HWIO kernel
+        inv = gamma / np.sqrt(var + 1e-5)
+        folded = {"kernel": jnp.asarray(np.transpose(w, (2, 3, 1, 0))),
+                  "scale": jnp.asarray(inv),
+                  "bias": jnp.asarray(beta - mean * inv)}
+        return out, folded
+
+    params = {}
+    x, params["stem"] = conv_bn("input", "stem", 3, 64, 7, 2, True)
+    # explicit symmetric pads (torch-style), matching the native model's
+    # reduce_window pads exactly — unlike the convs, where both sides
+    # share XLA's SAME rule
+    nodes.append(_node("MaxPool", [x], ["pool0"], kernel_shape=[3, 3],
+                       strides=[2, 2], pads=[1, 1, 1, 1]))
+    x = "pool0"
+    cin = 64
+    for stage, blocks in enumerate(STAGE_SIZES[50]):
+        cmid = 64 * (2 ** stage)
+        cout = cmid * 4
+        for block in range(blocks):
+            stride = 2 if (block == 0 and stage > 0) else 1
+            nm = f"s{stage}b{block}"
+            y, p1 = conv_bn(x, f"{nm}c1", cin, cmid, 1, 1, True)
+            y, p2 = conv_bn(y, f"{nm}c2", cmid, cmid, 3, stride, True)
+            y, p3 = conv_bn(y, f"{nm}c3", cmid, cout, 1, 1, False)
+            p = {"conv1": p1, "conv2": p2, "conv3": p3}
+            res = x
+            if stride != 1 or cin != cout:
+                res, p["proj"] = conv_bn(x, f"{nm}pj", cin, cout, 1,
+                                         stride, False)
+            nodes.append(_node("Add", [y, res], [f"{nm}_sum"]))
+            nodes.append(_node("Relu", [f"{nm}_sum"], [f"{nm}_out"]))
+            x = f"{nm}_out"
+            params[nm] = p
+            cin = cout
+    nodes.append(_node("GlobalAveragePool", [x], ["gap"]))
+    nodes.append(_node("Flatten", ["gap"], ["flat"], axis=1))
+    wfc = (rng.standard_normal((classes, cin)) * 0.01).astype(np.float32)
+    bfc = rng.standard_normal(classes).astype(np.float32)
+    inits.update({"wfc": wfc, "bfc": bfc})
+    nodes.append(_node("Gemm", ["flat", "wfc", "bfc"], ["logits"], transB=1))
+    params["fc"] = {"kernel": jnp.asarray(wfc.T), "bias": jnp.asarray(bfc)}
+
+    path = tmp_path / "rn50.onnx"
+    path.write_bytes(_model_bytes(nodes, inits, [("input", [1, 3, img, img])],
+                                  [("logits", [1, classes])]))
+    onnx_model = load_onnx_model(str(path), max_batch_size=2)
+    native = make_resnet(depth=50, num_classes=classes, image_size=img,
+                         compute_dtype=jnp.float32, params=params,
+                         max_batch_size=2)
+
+    xin = rng.standard_normal((2, 3, img, img)).astype(np.float32)
+    got = np.asarray(onnx_model.apply_fn(onnx_model.params,
+                                         {"input": xin})["logits"])
+    want = np.asarray(native.apply_fn(
+        native.params, {"input": np.transpose(xin, (0, 2, 3, 1))})["logits"])
+    assert got.shape == want.shape == (2, classes)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+# -------------------------------------- transformer-class encoder block ---
+def test_transformer_block_import(tmp_path):
+    """A BERT/ViT-style encoder block as exporters actually emit it:
+    LayerNormalization, MatMul+Add projections, the Shape->Gather->
+    Unsqueeze->Concat->Reshape dynamic-reshape idiom for heads,
+    Transpose, scaled-dot-product Softmax, erf-form Gelu, residuals.
+    Exercises the host-side shape pool (constant folding over
+    Constant/Shape-derived subgraphs without baking weights)."""
+    import jax
+
+    D, H, T, FF = 32, 4, 6, 64
+    hd = D // H
+    rng = np.random.default_rng(5)
+    f32 = lambda *s: (rng.standard_normal(s) / np.sqrt(s[0])).astype(  # noqa: E731
+        np.float32)
+    inits = {
+        "ln1_g": np.abs(f32(D)) + 0.5, "ln1_b": f32(D),
+        "wqkv": f32(D, 3 * D), "bqkv": f32(3 * D),
+        "wo": f32(D, D), "bo": f32(D),
+        "ln2_g": np.abs(f32(D)) + 0.5, "ln2_b": f32(D),
+        "w1": f32(D, FF), "b1": f32(FF), "w2": f32(FF, D), "b2": f32(D),
+        # shape-pool raw material
+        "g0": np.asarray([0], np.int64), "g1": np.asarray([1], np.int64),
+        "heads": np.asarray([H], np.int64),
+        "hd": np.asarray([hd], np.int64),
+        "negone": np.asarray([-1], np.int64),
+        "sqrt_hd": np.asarray(np.sqrt(hd), np.float32),
+        "half": np.asarray(0.5, np.float32),
+        "one": np.asarray(1.0, np.float32),
+        "sqrt2": np.asarray(np.sqrt(2.0), np.float32),
+    }
+    n = []
+    # pre-LN attention: x -> ln1 -> qkv -> heads -> sdpa -> wo -> +x
+    n.append(_node("LayerNormalization", ["x", "ln1_g", "ln1_b"], ["ln1"],
+                   epsilon=1e-5, axis=-1))
+    n.append(_node("MatMul", ["ln1", "wqkv"], ["qkv0"]))
+    n.append(_node("Add", ["qkv0", "bqkv"], ["qkv"]))
+    # (B,T,3D) -> (B,T,3,H,hd) via the Shape idiom, then per-slot Gather
+    n.append(_node("Shape", ["x"], ["xshape"]))
+    for name, idx in (("bdim", "g0"), ("tdim", "g1")):
+        n.append(_node("Gather", ["xshape", idx], [name], axis=0))
+    n.append(_node("Concat", ["bdim", "tdim", "negone", "heads", "hd"],
+                   ["qkv_shape"], axis=0))
+    n.append(_node("Reshape", ["qkv", "qkv_shape"], ["qkv5"]))
+    n.append(_node("Transpose", ["qkv5"], ["qkv_t"],
+                   perm=[2, 0, 3, 1, 4]))      # (3,B,H,T,hd)
+    n.append(_node("Split", ["qkv_t"], ["q_", "k_", "v_"], axis=0))
+    for nm in ("q", "k", "v"):
+        n.append(_node("Squeeze", [f"{nm}_"], [nm], axes=[0]))
+    n.append(_node("Transpose", ["k"], ["kT"], perm=[0, 1, 3, 2]))
+    n.append(_node("MatMul", ["q", "kT"], ["scores0"]))
+    n.append(_node("Div", ["scores0", "sqrt_hd"], ["scores"]))
+    n.append(_node("Softmax", ["scores"], ["probs"], axis=-1))
+    n.append(_node("MatMul", ["probs", "v"], ["ctx"]))      # (B,H,T,hd)
+    n.append(_node("Transpose", ["ctx"], ["ctx_t"], perm=[0, 2, 1, 3]))
+    n.append(_node("Concat", ["bdim", "tdim", "negone"], ["merge_shape"],
+                   axis=0))
+    n.append(_node("Reshape", ["ctx_t", "merge_shape"], ["merged"]))
+    n.append(_node("MatMul", ["merged", "wo"], ["attn0"]))
+    n.append(_node("Add", ["attn0", "bo"], ["attn"]))
+    n.append(_node("Add", ["x", "attn"], ["res1"]))
+    # pre-LN MLP with erf-form Gelu: 0.5*h*(1+erf(h/sqrt(2)))
+    n.append(_node("LayerNormalization", ["res1", "ln2_g", "ln2_b"],
+                   ["ln2"], epsilon=1e-5, axis=-1))
+    n.append(_node("MatMul", ["ln2", "w1"], ["h0"]))
+    n.append(_node("Add", ["h0", "b1"], ["h1"]))
+    n.append(_node("Div", ["h1", "sqrt2"], ["h2"]))
+    n.append(_node("Erf", ["h2"], ["h3"]))
+    n.append(_node("Add", ["h3", "one"], ["h4"]))
+    n.append(_node("Mul", ["h1", "h4"], ["h5"]))
+    n.append(_node("Mul", ["h5", "half"], ["gelu"]))
+    n.append(_node("MatMul", ["gelu", "w2"], ["m0"]))
+    n.append(_node("Add", ["m0", "b2"], ["m1"]))
+    n.append(_node("Add", ["res1", "m1"], ["y"]))
+
+    path = tmp_path / "encoder.onnx"
+    path.write_bytes(_model_bytes(n, inits, [("x", [1, T, D])],
+                                  [("y", [1, T, D])]))
+    m = load_onnx_model(str(path), max_batch_size=2)
+
+    def expected(x):
+        def ln(v, g, b):
+            mu = v.mean(-1, keepdims=True)
+            var = ((v - mu) ** 2).mean(-1, keepdims=True)
+            return (v - mu) / np.sqrt(var + 1e-5) * g + b
+        B = x.shape[0]
+        h = ln(x, inits["ln1_g"], inits["ln1_b"])
+        qkv = (h @ inits["wqkv"] + inits["bqkv"]).reshape(B, T, 3, H, hd)
+        q, k, v = [qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3)]
+        s = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+        p = np.asarray(jax.nn.softmax(s, axis=-1))
+        ctx = (p @ v).transpose(0, 2, 1, 3).reshape(B, T, D)
+        r1 = x + ctx @ inits["wo"] + inits["bo"]
+        h2 = ln(r1, inits["ln2_g"], inits["ln2_b"]) @ inits["w1"] + inits["b1"]
+        g = 0.5 * h2 * (1 + np.asarray(jax.scipy.special.erf(
+            np.asarray(h2 / np.sqrt(2.0)))))
+        return r1 + g @ inits["w2"] + inits["b2"]
+
+    for b in (1, 2):  # the Shape idiom must rebind per traced batch
+        x = rng.standard_normal((b, T, D)).astype(np.float32)
+        got = np.asarray(m.apply_fn(m.params, {"x": x})["y"])
+        np.testing.assert_allclose(got, expected(x), rtol=2e-4, atol=2e-5)
 
 
 # ------------------------------------------------- reference zoo artifact --

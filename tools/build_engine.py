@@ -18,12 +18,18 @@ def _verify_onnx(model, data_dir: str) -> None:
     examples/ONNX mnist flow: run bundled inputs, compare outputs)."""
     import glob
     import os
+    import re
 
     import numpy as np
     from tpulab.models.onnx_import import load_tensor_pb
 
-    ins = sorted(glob.glob(os.path.join(data_dir, "input_*.pb")))
-    outs = sorted(glob.glob(os.path.join(data_dir, "output_*.pb")))
+    def by_index(p):  # input_10.pb must sort after input_2.pb
+        return int(re.search(r"_(\d+)\.pb$", p).group(1))
+
+    ins = sorted(glob.glob(os.path.join(data_dir, "input_*.pb")),
+                 key=by_index)
+    outs = sorted(glob.glob(os.path.join(data_dir, "output_*.pb")),
+                  key=by_index)
     if len(ins) != len(model.inputs) or len(outs) != len(model.outputs):
         raise SystemExit(
             f"--verify-dir {data_dir}: found {len(ins)} input / "

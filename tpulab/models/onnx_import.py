@@ -281,14 +281,37 @@ class _Converter:
         self.g = model.graph
         self.opset = model.opset
         self.static: Dict[str, np.ndarray] = dict(self.g.initializers)
+        #: host-computable values derived from Constant/Shape chains (the
+        #: exporters' dynamic-reshape idiom: Shape->Gather->Concat->Reshape).
+        #: Weight initializers are deliberately NOT foldable through here —
+        #: folding them would bake weights into the executable as constants
+        #: instead of reading the params pytree.
+        self._shape_pool: Dict[str, np.ndarray] = {}
+        self._const_names: set = set()
 
     # -- static (host) values ------------------------------------------------
     def _static_val(self, name: str) -> np.ndarray:
+        if name in self._shape_pool:
+            return self._shape_pool[name]
         if name not in self.static:
             raise NotImplementedError(
                 f"input {name!r} must be a static initializer/Constant "
                 "(data-dependent shapes cannot compile to static XLA shapes)")
         return self.static[name]
+
+    def _pool_val(self, name: str) -> Optional[np.ndarray]:
+        if name in self._shape_pool:
+            return self._shape_pool[name]
+        if name in self._const_names:
+            return self.static[name]
+        # small integer initializers are shape material (gather indices,
+        # axes, reshape targets), never swappable weights — poolable.
+        # Float initializers stay in params so weights are read, not baked.
+        v = self.static.get(name)
+        if (v is not None and v.dtype.kind in "iu" and v.size <= 64
+                and v.ndim <= 1):
+            return v
+        return None
 
     def prefold_constants(self) -> None:
         """Constant nodes join the static pool (and params) up front."""
@@ -298,10 +321,12 @@ class _Converter:
                 if val is None:
                     raise NotImplementedError("Constant without 'value'")
                 self.static[node.outputs[0]] = np.asarray(val)
+                self._const_names.add(node.outputs[0])
 
     # -- the traced evaluator ------------------------------------------------
     def build(self) -> Tuple[Callable, Dict[str, np.ndarray],
                              List[str], List[str]]:
+        import jax
         import jax.numpy as jnp  # noqa: F401  (ops close over jnp/lax)
 
         self.prefold_constants()
@@ -320,11 +345,37 @@ class _Converter:
                     env[node.outputs[0]] = jnp.asarray(
                         self.static[node.outputs[0]])
                     continue
+                real_ins = [i for i in node.inputs if i]
+                if node.op == "Shape":
+                    # always host-static under trace (XLA shapes are
+                    # static); seeds the shape pool
+                    val = np.asarray(env[real_ins[0]].shape, np.int64)
+                    self._shape_pool[node.outputs[0]] = val
+                    env[node.outputs[0]] = val
+                    continue
                 fn = _OPS.get(node.op)
                 if fn is None:
                     raise NotImplementedError(
                         f"ONNX op {node.op!r} (node {node.name!r}) is not "
                         "supported by the importer")
+                pooled = [self._pool_val(i) for i in real_ins]
+                if real_ins and all(v is not None for v in pooled):
+                    # whole-subgraph fold on Constant/Shape-derived values
+                    # (trace-deterministic: same inputs every trace).
+                    # ensure_compile_time_eval escapes the enclosing jit
+                    # trace so the registered op runs eagerly on the
+                    # concrete arrays — back to numpy and into the pool.
+                    it = iter(pooled)
+                    args = [next(it) if i else None for i in node.inputs]
+                    with jax.ensure_compile_time_eval():
+                        res = fn(self, node, args)
+                    res = res if isinstance(res, tuple) else (res,)
+                    for out_name, val in zip(node.outputs, res):
+                        if out_name:
+                            val = np.asarray(val)
+                            self._shape_pool[out_name] = val
+                            env[out_name] = val
+                    continue
                 args = [env[i] if i else None for i in node.inputs]
                 res = fn(self, node, args)
                 if not isinstance(res, tuple):
@@ -533,9 +584,16 @@ def _reshape(conv, node, args):
     # ONNX 0 = copy input dim (allowzero=0 default)
     target = [int(x.shape[i]) if d == 0 else d for i, d in enumerate(target)]
     # batch-bucket serving: a fixed leading dim baked at export batch (the
-    # zoo exports at N=1) re-binds to the runtime batch when that is the
-    # only way the element counts reconcile
-    if -1 not in target and math.prod(target) != math.prod(x.shape):
+    # zoo exports at N=1) re-binds to the runtime batch.  Without -1 the
+    # rebind happens when that is the only way the element counts
+    # reconcile; with -1 any leading dim "reconciles" (the -1 absorbs
+    # the difference, silently merging batch rows), so rebind exactly
+    # the baked-N=1 idiom ([1, ...] at runtime batch > 1) and leave
+    # genuine flatten targets ([-1, F]) untouched
+    if -1 in target:
+        if target[0] == 1 and x.shape[0] != 1:
+            target = [int(x.shape[0])] + target[1:]
+    elif math.prod(target) != math.prod(x.shape):
         rebind = [int(x.shape[0])] + target[1:]
         if math.prod(rebind) == math.prod(x.shape):
             target = rebind
@@ -641,6 +699,143 @@ def _unsqueeze(conv, node, args):
     if axes is None and len(node.inputs) > 1:
         axes = [int(a) for a in conv._static_val(node.inputs[1])]
     return jnp.expand_dims(args[0], tuple(int(a) for a in axes))
+
+
+# ---- transformer-class ops (attention/MLP graphs: ViT, BERT-family) ----
+
+for _name, _fn in (("Sqrt", "sqrt"), ("Erf", "erf"), ("Exp", "exp"),
+                   ("Log", "log"), ("Neg", "negative"), ("Abs", "abs"),
+                   ("Floor", "floor"), ("Ceil", "ceil")):
+    def _unary(conv, node, args, _fn=_fn):
+        import jax.numpy as jnp
+        import jax.scipy.special as jsp
+        fn = getattr(jnp, _fn, None) or getattr(jsp, _fn)
+        return fn(args[0])
+    _OPS[_name] = _unary
+
+
+@_op("Gelu")
+def _gelu(conv, node, args):
+    import jax
+    approx = node.attrs.get("approximate", b"none")
+    approx = approx.decode() if isinstance(approx, bytes) else approx
+    return jax.nn.gelu(args[0], approximate=(approx == "tanh"))
+
+
+@_op("LayerNormalization")
+def _layernorm(conv, node, args):
+    import jax.numpy as jnp
+    x, scale = args[0], args[1]
+    eps = node.attrs.get("epsilon", 1e-5)
+    axis = int(node.attrs.get("axis", -1))
+    axes = tuple(range(axis if axis >= 0 else x.ndim + axis, x.ndim))
+    mean = jnp.mean(x, axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps) * scale
+    if len(args) > 2 and args[2] is not None:
+        y = y + args[2]
+    return y
+
+
+@_op("ReduceSum")
+def _reduce_sum(conv, node, args):
+    import jax.numpy as jnp
+    axes = node.attrs.get("axes")
+    if axes is None and len(node.inputs) > 1 and node.inputs[1]:
+        axes = [int(a) for a in conv._static_val(node.inputs[1])]
+    return jnp.sum(args[0], axis=tuple(axes) if axes else None,
+                   keepdims=bool(node.attrs.get("keepdims", 1)))
+
+
+@_op("Slice")
+def _slice(conv, node, args):
+    x = args[0]
+    if len(node.inputs) > 1:  # opset 10+: starts/ends/axes/steps inputs
+        starts = [int(v) for v in conv._static_val(node.inputs[1])]
+        ends = [int(v) for v in conv._static_val(node.inputs[2])]
+        axes = ([int(v) for v in conv._static_val(node.inputs[3])]
+                if len(node.inputs) > 3 and node.inputs[3]
+                else list(range(len(starts))))
+        steps = ([int(v) for v in conv._static_val(node.inputs[4])]
+                 if len(node.inputs) > 4 and node.inputs[4]
+                 else [1] * len(starts))
+    else:                      # opset 1: attributes
+        starts = [int(v) for v in node.attrs["starts"]]
+        ends = [int(v) for v in node.attrs["ends"]]
+        axes = [int(v) for v in node.attrs.get(
+            "axes", range(len(starts)))]
+        steps = [1] * len(starts)
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, steps):
+        # ONNX clamps INT_MAX/INT_MIN sentinels like python slices do
+        idx[a if a >= 0 else x.ndim + a] = slice(
+            None if s == 0 and st > 0 else s,
+            None if abs(e) >= (1 << 31) else e, st)
+    return x[tuple(idx)]
+
+
+@_op("Gather")
+def _gather(conv, node, args):
+    import jax.numpy as jnp
+    axis = int(node.attrs.get("axis", 0))
+    return jnp.take(args[0], args[1].astype(jnp.int32), axis=axis)
+
+
+@_op("Split")
+def _split(conv, node, args):
+    import jax.numpy as jnp
+    x = args[0]
+    axis = int(node.attrs.get("axis", 0))
+    sizes = node.attrs.get("split")
+    if sizes is None and len(node.inputs) > 1 and node.inputs[1]:
+        sizes = [int(v) for v in conv._static_val(node.inputs[1])]
+    if sizes is None:
+        n = len(node.outputs)
+        sizes = [x.shape[axis] // n] * n
+    bounds = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, bounds, axis=axis))
+
+
+@_op("Where")
+def _where(conv, node, args):
+    import jax.numpy as jnp
+    return jnp.where(args[0], args[1], args[2])
+
+
+@_op("Equal")
+def _equal(conv, node, args):
+    import jax.numpy as jnp
+    return jnp.equal(args[0], args[1])
+
+
+# NOTE: "Shape" is special-cased in the evaluator (seeds the host-side
+# shape pool; always static under trace), not registered here.
+
+
+@_op("Expand")
+def _expand(conv, node, args):
+    import jax.numpy as jnp
+    target = [int(d) for d in conv._static_val(node.inputs[1])]
+    return jnp.broadcast_to(args[0], np.broadcast_shapes(
+        tuple(args[0].shape), tuple(target)))
+
+
+@_op("Min")
+def _min(conv, node, args):
+    import jax.numpy as jnp
+    out = args[0]
+    for a in args[1:]:
+        out = jnp.minimum(out, a)
+    return out
+
+
+@_op("Max")
+def _max(conv, node, args):
+    import jax.numpy as jnp
+    out = args[0]
+    for a in args[1:]:
+        out = jnp.maximum(out, a)
+    return out
 
 
 # --------------------------------------------------------------------------
