@@ -750,15 +750,6 @@ def main() -> None:
                 _record(llm_decode=benchmark_llm_decode())
             except Exception as e:
                 print(f"# llm decode row skipped: {e!r}", file=sys.stderr)
-            try:
-                # speculative decoding's reason to exist, measured
-                # (VERDICT r4 #7): acceptance + speedup vs serving-shaped
-                # plain decode; emulated-draft caveat in the function doc
-                _phase("speculative")
-                from tpulab.engine.speculative import benchmark_speculative
-                _record(speculative=benchmark_speculative())
-            except Exception as e:
-                print(f"# speculative row skipped: {e!r}", file=sys.stderr)
 
     # flagship serving config (examples/02 analog): gRPC + dynamic batching
     # over localhost (reference 98-series measurement).  Runs in degraded
@@ -862,6 +853,18 @@ def main() -> None:
                 if srv2 is not None:
                     srv2.shutdown()
         _record(grpc_window_sweep=wsweep)
+
+    # speculative decoding's reason to exist, measured (VERDICT r4 #7):
+    # acceptance + speedup vs serving-shaped plain decode (emulated-draft
+    # caveat in the function doc).  LAST on purpose: a watchdog cut here
+    # costs only this row, never the serving rows above
+    if not degraded and not cpu_full and on_tpu:
+        try:
+            _phase("speculative")
+            from tpulab.engine.speculative import benchmark_speculative
+            _record(speculative=benchmark_speculative())
+        except Exception as e:
+            print(f"# speculative row skipped: {e!r}", file=sys.stderr)
 
     _phase("emit")
     with _state_lock:

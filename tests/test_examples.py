@@ -1,6 +1,7 @@
 """Example smoke tests (hermetic CPU): the quickstart flow, the CLI bench,
 the echo service, and the batching middleman end-to-end."""
 
+import os
 import subprocess
 import sys
 import threading
@@ -25,6 +26,24 @@ def test_30_python_api_quickstart():
         capture_output=True, text=True, timeout=300, env=env)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "remote == local: OK" in out.stdout
+
+
+def test_13_onnx_serving_example(tmp_path):
+    """ONNX import -> engine artifact -> serve -> golden check over the
+    wire (the reference's examples/ONNX workflow); skips gracefully when
+    the reference tree is absent."""
+    if not os.path.exists("/root/reference/models/onnx/mnist-v1.3"):
+        pytest.skip("reference mnist-v1.3 not present")
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "TPULAB_FORCE_CPU": "1", "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, f"{REPO}/examples/13_onnx_serving.py", "--cpu",
+         "--engine-dir", str(tmp_path / "eng")],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "golden check" in out.stdout and "OK" in out.stdout
+    assert (tmp_path / "eng" / "spec.json").exists()
 
 
 def test_01_echo_service_loopback():

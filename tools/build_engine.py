@@ -82,7 +82,14 @@ def main():
         from tpulab.models.onnx_import import load_onnx_model
         model = load_onnx_model(args.onnx, max_batch_size=args.max_batch)
         if args.verify_dir:
+            # golden vectors are float references: verify the float
+            # import (int8 error ~% can never meet float tolerances),
+            # then quantize the verified model
             _verify_onnx(model, args.verify_dir)
+        if args.int8:
+            model = load_onnx_model(args.onnx,
+                                    max_batch_size=args.max_batch,
+                                    weight_quant="int8")
     elif args.torch_checkpoint:
         if not args.model.startswith("resnet"):
             ap.error("--torch-checkpoint supports resnet models only")
@@ -92,9 +99,9 @@ def main():
                                        **kwargs)
     else:
         model = build_model(args.model, **kwargs)
-    if args.int8:
-        if args.onnx or not args.model.startswith("resnet"):
-            ap.error("--int8 quantization supports resnet models only")
+    if args.int8 and not args.onnx:  # --onnx quantizes at import above
+        if not args.model.startswith("resnet"):
+            ap.error("--int8 quantization supports resnet and onnx models")
         from tpulab.models.quantization import quantize_resnet_params
         model.params = quantize_resnet_params(model.params)
 

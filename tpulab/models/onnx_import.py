@@ -388,6 +388,51 @@ class _Converter:
         return apply_fn, params, graph_inputs, out_names
 
 
+def _weight_names(graph: OnnxGraph) -> set:
+    """Initializer names whose EVERY use is the weight slot (input 1) of
+    Conv/MatMul/Gemm — the only params safe to store quantized (any other
+    consumer, e.g. a Reshape, would receive the {w_int8, scale} dict)."""
+    eligible: Dict[str, bool] = {}
+    for node in graph.nodes:
+        for i, name in enumerate(node.inputs):
+            if name in graph.initializers:
+                ok = (i == 1 and node.op in ("Conv", "MatMul", "Gemm"))
+                eligible[name] = eligible.get(name, True) and ok
+    return {n for n, ok in eligible.items() if ok}
+
+
+def quantize_onnx_weights(params: Dict[str, np.ndarray], names: set,
+                          min_size: int = 1024) -> Dict[str, Any]:
+    """Weight-only INT8 (W8A16 analog of models/quantization.py, for
+    imported graphs): eligible float32 weights >= min_size become
+    {w_int8, scale} — per-output-channel scales for 4-D OIHW conv
+    kernels, per-tensor for 2-D (the matmul orientation is not knowable
+    from the tensor alone).  Dequant happens in the consuming op's
+    epilogue (XLA fuses it); HBM and weight-read bandwidth drop 4x."""
+    out: Dict[str, Any] = {}
+    for k, v in params.items():
+        if (k in names and isinstance(v, np.ndarray)
+                and v.dtype == np.float32 and v.ndim in (2, 4)
+                and v.size >= min_size):
+            amax = (np.abs(v).max(axis=(1, 2, 3), keepdims=True)
+                    if v.ndim == 4 else np.abs(v).max())
+            scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+            out[k] = {"w_int8": np.clip(np.round(v / scale), -127, 127
+                                        ).astype(np.int8),
+                      "scale": scale}
+        else:
+            out[k] = v
+    return out
+
+
+def _wval(w):
+    """Weight slot: transparent dequant of {w_int8, scale} entries."""
+    if isinstance(w, dict) and "w_int8" in w:
+        import jax.numpy as jnp
+        return w["w_int8"].astype(jnp.float32) * w["scale"]
+    return w
+
+
 # op implementations -- each: (conv: _Converter, node, args) -> array | tuple
 _OPS: Dict[str, Callable] = {}
 
@@ -415,7 +460,7 @@ def _conv_padding(node: OnnxNode, nd: int):
 @_op("Conv")
 def _conv(conv, node, args):
     from jax import lax
-    x, w = args[0], args[1]
+    x, w = args[0], _wval(args[1])
     nd = x.ndim - 2
     spatial = "".join("DHW"[3 - nd:])
     dn = lax.conv_dimension_numbers(
@@ -557,13 +602,13 @@ def _sum(conv, node, args):
 @_op("MatMul")
 def _matmul(conv, node, args):
     import jax.numpy as jnp
-    return jnp.matmul(args[0], args[1])
+    return jnp.matmul(args[0], _wval(args[1]))
 
 
 @_op("Gemm")
 def _gemm(conv, node, args):
     import jax.numpy as jnp
-    a, b = args[0], args[1]
+    a, b = args[0], _wval(args[1])
     if int(node.attrs.get("transA", 0)):
         a = a.T
     if int(node.attrs.get("transB", 0)):
@@ -850,7 +895,8 @@ def parse_onnx(path: str) -> OnnxModel:
 
 def load_onnx_model(path: str, name: Optional[str] = None,
                     max_batch_size: int = 8,
-                    batch_buckets: Optional[Sequence[int]] = None):
+                    batch_buckets: Optional[Sequence[int]] = None,
+                    weight_quant: Optional[str] = None):
     """``model.onnx`` -> servable :class:`~tpulab.engine.model.Model`.
 
     The ONNX graph's leading input dim is the batch axis (symbolic or the
@@ -858,11 +904,19 @@ def load_onnx_model(path: str, name: Optional[str] = None,
     per bucket (its static-shape 'optimization profiles').  Mirrors
     reference examples/ONNX/resnet50/build.py:33-70 (parser -> network ->
     engine) with XLA as the builder.
+
+    ``weight_quant="int8"`` stores eligible conv/matmul weights as
+    {w_int8, scale} with in-epilogue dequant (weight-only W8A16 — the
+    imported-model analog of the reference's INT8 ONNX engines).
     """
     from tpulab.engine.model import IOSpec, Model
 
     om = parse_onnx(path)
     apply_fn, params, in_names, out_names = _Converter(om).build()
+    if weight_quant is not None:
+        if weight_quant != "int8":
+            raise ValueError(f"unknown weight_quant {weight_quant!r}")
+        params = quantize_onnx_weights(params, _weight_names(om.graph))
 
     in_specs = []
     info = {n: (dt, dims) for n, dt, dims in om.graph.inputs}
