@@ -389,6 +389,144 @@ def test_onnx_weight_only_int8(tmp_path):
     assert not np.allclose(yq, yf, rtol=1e-7, atol=1e-7)  # really quantized
 
 
+# -------------------------------- segmentation-class ops (U-Net idioms) ---
+def test_conv_transpose_matches_manual_scatter():
+    """ConvTranspose (stride 2, pad 1, the U-Net upsample) against a
+    direct scatter-accumulate implementation of the ONNX deconv spec."""
+    rng = np.random.default_rng(9)
+    x = rng.standard_normal((1, 3, 5, 5)).astype(np.float32)
+    w = rng.standard_normal((3, 4, 3, 3)).astype(np.float32)  # (Cin,Cout,k,k)
+    b = rng.standard_normal(4).astype(np.float32)
+    stride, pad, k = 2, 1, 3
+
+    def manual():
+        H = (5 - 1) * stride + k - 2 * pad
+        out = np.zeros((1, 4, H + 2 * pad, H + 2 * pad), np.float32)
+        for i in range(5):
+            for j in range(5):
+                patch = np.einsum("c,cokl->okl", x[0, :, i, j], w)
+                out[0, :, i * stride:i * stride + k,
+                    j * stride:j * stride + k] += patch
+        return out[:, :, pad:pad + H, pad:pad + H] + b.reshape(1, -1, 1, 1)
+
+    inits = {"w": w, "b": b}
+    nodes = [_node("ConvTranspose", ["x", "w", "b"], ["y"],
+                   kernel_shape=[k, k], strides=[stride, stride],
+                   pads=[pad, pad, pad, pad])]
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".onnx", delete=False) as f:
+        f.write(_model_bytes(nodes, inits, [("x", [1, 3, 5, 5])],
+                             [("y", [1, 4, 9, 9])]))
+    m = load_onnx_model(f.name, max_batch_size=2)
+    got = np.asarray(m.apply_fn(m.params, {"x": x})["y"])
+    os.unlink(f.name)
+    assert got.shape == (1, 4, 9, 9)
+    np.testing.assert_allclose(got, manual(), rtol=1e-4, atol=1e-4)
+
+
+def test_unet_style_block(tmp_path):
+    """Conv -> InstanceNormalization -> PRelu -> Resize(nearest, x2) ->
+    skip Concat -> 1x1 Conv -> ArgMax: the segmentation-decoder idiom
+    end-to-end through the importer."""
+    rng = np.random.default_rng(12)
+    inits = {
+        "w1": (rng.standard_normal((4, 3, 3, 3)) / 5).astype(np.float32),
+        "in_s": (0.5 + rng.random(4)).astype(np.float32),
+        "in_b": rng.standard_normal(4).astype(np.float32),
+        "slope": (0.1 * rng.random(4)).astype(np.float32),
+        "scales": np.asarray([1.0, 1.0, 2.0, 2.0], np.float32),
+        "w2": (rng.standard_normal((2, 7, 1, 1)) / 3).astype(np.float32),
+    }
+    nodes = [
+        _node("Conv", ["x", "w1"], ["c1"], kernel_shape=[3, 3],
+              strides=[2, 2], auto_pad=b"SAME_UPPER"),        # (B,4,4,4)
+        _node("InstanceNormalization", ["c1", "in_s", "in_b"], ["n1"],
+              epsilon=1e-5),
+        _node("PRelu", ["n1", "slope"], ["p1"]),
+        _node("Resize", ["p1", "", "scales"], ["up"]),        # (B,4,8,8)
+        _node("Concat", ["up", "x"], ["cat"], axis=1),        # (B,7,8,8)
+        _node("Conv", ["cat", "w2"], ["seg"], kernel_shape=[1, 1]),
+        _node("ArgMax", ["seg"], ["mask"], axis=1, keepdims=0),
+    ]
+    p = tmp_path / "unet.onnx"
+    p.write_bytes(_model_bytes(nodes, inits, [("x", [1, 3, 8, 8])],
+                               [("mask", [1, 8, 8])]))
+    m = load_onnx_model(str(p), max_batch_size=2)
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    out = m.apply_fn(m.params, {"x": x})
+    mask = np.asarray(out["mask"])
+    assert mask.shape == (2, 8, 8)
+    assert set(np.unique(mask)) <= {0, 1}
+    # expected path in numpy/jax for the numeric stages
+    from jax import lax
+    dn = lax.conv_dimension_numbers(x.shape, inits["w1"].shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    c1 = np.asarray(lax.conv_general_dilated(
+        x, inits["w1"], (2, 2), "SAME", dimension_numbers=dn))
+    mu = c1.mean((2, 3), keepdims=True)
+    var = ((c1 - mu) ** 2).mean((2, 3), keepdims=True)
+    n1 = ((c1 - mu) / np.sqrt(var + 1e-5)
+          * inits["in_s"].reshape(1, -1, 1, 1)
+          + inits["in_b"].reshape(1, -1, 1, 1))
+    p1 = np.where(n1 > 0, n1, n1 * inits["slope"].reshape(1, -1, 1, 1))
+    up = p1.repeat(2, axis=2).repeat(2, axis=3)   # nearest x2
+    cat = np.concatenate([up, x], axis=1)
+    dn2 = lax.conv_dimension_numbers(cat.shape, inits["w2"].shape,
+                                     ("NCHW", "OIHW", "NCHW"))
+    seg = np.asarray(lax.conv_general_dilated(
+        cat, inits["w2"], (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=dn2))
+    np.testing.assert_array_equal(mask, seg.argmax(1))
+
+
+def test_misc_elementwise_and_reduce_ops(tmp_path):
+    """HardSigmoid, LogSoftmax, ReduceMax, Tile, and the two-input
+    Upsample-9 form, each against its numpy reference."""
+    import jax
+
+    rng = np.random.default_rng(21)
+    inits = {"reps": np.asarray([1, 2, 1], np.int64),
+             "up_scales": np.asarray([1.0, 1.0, 2.0, 2.0], np.float32)}
+    nodes = [
+        _node("HardSigmoid", ["x"], ["hs"], alpha=0.25, beta=0.4),
+        _node("LogSoftmax", ["x"], ["ls"], axis=-1),
+        _node("ReduceMax", ["x"], ["rm"], axes=[1], keepdims=1),
+        _node("Tile", ["x", "reps"], ["tl"]),
+        _node("Reshape", ["x", "img_shape"], ["ximg"]),
+        _node("Upsample", ["ximg", "up_scales"], ["up"], mode=b"nearest"),
+    ]
+    inits["img_shape"] = np.asarray([0, 1, 2, 3], np.int64)  # (B,1,2,3)
+    p = tmp_path / "misc.onnx"
+    p.write_bytes(_model_bytes(
+        nodes, inits, [("x", [1, 2, 3])],
+        [("hs", [1, 2, 3]), ("ls", [1, 2, 3]), ("rm", [1, 1, 3]),
+         ("tl", [1, 4, 3]), ("up", [1, 1, 4, 6])]))
+    m = load_onnx_model(str(p), max_batch_size=2)
+    x = rng.standard_normal((2, 2, 3)).astype(np.float32)
+    out = m.apply_fn(m.params, {"x": x})
+    np.testing.assert_allclose(np.asarray(out["hs"]),
+                               np.clip(0.25 * x + 0.4, 0, 1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["ls"]),
+                               np.asarray(jax.nn.log_softmax(x, axis=-1)),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["rm"]),
+                               x.max(1, keepdims=True), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["tl"]),
+                               np.tile(x, [1, 2, 1]), rtol=1e-6)
+    want_up = x.reshape(2, 1, 2, 3).repeat(2, 2).repeat(2, 3)
+    np.testing.assert_allclose(np.asarray(out["up"]), want_up, rtol=1e-6)
+    # unsupported attribute combos raise, not silently miscompute
+    bad = _model_bytes([_node("Resize", ["x", "", "s"], ["y"],
+                              mode=b"linear",
+                              coordinate_transformation_mode=b"align_corners")],
+                       {"s": np.asarray([1., 1., 2.], np.float32)},
+                       [("x", [1, 2, 3])], [("y", [1, 2, 6])])
+    pb = tmp_path / "bad.onnx"
+    pb.write_bytes(bad)
+    with pytest.raises(NotImplementedError, match="align_corners"):
+        load_onnx_model(str(pb), max_batch_size=1)
+
+
 # -------------------------------------- transformer-class encoder block ---
 def test_transformer_block_import(tmp_path):
     """A BERT/ViT-style encoder block as exporters actually emit it:

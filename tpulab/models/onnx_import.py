@@ -865,6 +865,167 @@ def _expand(conv, node, args):
         tuple(args[0].shape), tuple(target)))
 
 
+# ---- detection/segmentation-class ops (U-Net/deconv/resize idioms) ----
+
+@_op("ConvTranspose")
+def _conv_transpose(conv, node, args):
+    from jax import lax
+    x, w = args[0], _wval(args[1])          # w: (Cin, Cout/groups, kH, kW)
+    nd = x.ndim - 2
+    if int(node.attrs.get("group", 1)) != 1:
+        raise NotImplementedError("grouped ConvTranspose")
+    strides = [int(s) for s in node.attrs.get("strides", [1] * nd)]
+    dil = [int(d) for d in node.attrs.get("dilations", [1] * nd)]
+    if "output_shape" in node.attrs:
+        raise NotImplementedError("ConvTranspose output_shape")
+    pads = node.attrs.get("pads")
+    opad = [int(p) for p in node.attrs.get("output_padding", [0] * nd)]
+    if pads is None:
+        auto = node.attrs.get("auto_pad", b"NOTSET")
+        auto = auto.decode() if isinstance(auto, bytes) else auto
+        if auto in ("NOTSET", "", "VALID"):
+            pads = [0] * (2 * nd)
+        else:
+            raise NotImplementedError(f"ConvTranspose auto_pad={auto}")
+    pairs = _pair_pads([int(p) for p in pads], nd)
+    ks = w.shape[2:]
+    # ONNX deconv == gradient-style transposed conv: express as a dilated
+    # conv of the input with the spatially-flipped kernel (IOHW -> OIHW
+    # swap), padding k-1-pad on each edge (+output_padding at the end)
+    spatial = "".join("DHW"[3 - nd:])
+    wt = w.swapaxes(0, 1)
+    wt = wt[(slice(None), slice(None)) + (slice(None, None, -1),) * nd]
+    pad_cfg = [(dil[i] * (ks[i] - 1) - pairs[i][0],
+                dil[i] * (ks[i] - 1) - pairs[i][1] + opad[i])
+               for i in range(nd)]
+    dn = lax.conv_dimension_numbers(
+        x.shape, wt.shape, (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
+    out = lax.conv_general_dilated(
+        x, wt, window_strides=[1] * nd, padding=pad_cfg,
+        lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn)
+    if len(args) > 2 and args[2] is not None:
+        out = out + args[2].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@_op("Resize")
+@_op("Upsample")          # opset-7/9 Upsample: same semantics, scales only
+def _resize(conv, node, args):
+    import jax
+    x = args[0]
+    mode = node.attrs.get("mode", b"nearest")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    # jax.image.resize uses the half-pixel convention; other coordinate
+    # transforms (align_corners, asymmetric) would be silently wrong, so
+    # they raise like every other unsupported path here
+    ct = node.attrs.get("coordinate_transformation_mode", b"half_pixel")
+    ct = ct.decode() if isinstance(ct, bytes) else ct
+    if ct not in ("half_pixel", "pytorch_half_pixel"):
+        raise NotImplementedError(
+            f"Resize coordinate_transformation_mode={ct}")
+    nm = node.attrs.get("nearest_mode", b"round_prefer_floor")
+    nm = nm.decode() if isinstance(nm, bytes) else nm
+    if mode == "nearest" and nm not in ("round_prefer_floor", "floor"):
+        # jax nearest == floor(half-pixel coord); round_prefer_floor
+        # coincides at the integer scale factors upsamplers use
+        raise NotImplementedError(f"Resize nearest_mode={nm}")
+    sizes = scales = None
+    if len(node.inputs) >= 4 and node.inputs[3]:
+        # opset 11+: X, roi, scales, sizes (scales/sizes must be static)
+        sizes = [int(s) for s in conv._static_val(node.inputs[3])]
+    elif len(node.inputs) >= 3 and node.inputs[2]:
+        sc = conv._static_val(node.inputs[2])
+        if sc.size:
+            scales = [float(s) for s in sc]
+    elif len(node.inputs) == 2 and node.inputs[1]:
+        # opset 9/10 (Upsample-9, Resize-10): X, scales
+        scales = [float(s) for s in conv._static_val(node.inputs[1])]
+    elif "scales" in node.attrs:                  # Upsample-7 attribute
+        scales = [float(s) for s in node.attrs["scales"]]
+    if sizes is None:
+        if scales is None:
+            raise NotImplementedError("Resize without scales/sizes")
+        # spec: output dim = floor(input dim * scale)
+        sizes = [int(math.floor(d * s)) for d, s in zip(x.shape, scales)]
+    if tuple(sizes[:2]) != tuple(x.shape[:2]):
+        raise NotImplementedError("Resize over batch/channel dims")
+    method = {"nearest": "nearest", "linear": "bilinear",
+              "cubic": "bicubic"}.get(mode)
+    if method is None:
+        raise NotImplementedError(f"Resize mode={mode}")
+    return jax.image.resize(x, tuple(sizes), method=method)
+
+
+@_op("InstanceNormalization")
+def _instance_norm(conv, node, args):
+    import jax.numpy as jnp
+    x, scale, bias = args[0], args[1], args[2]
+    eps = node.attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axes, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return ((x - mean) / jnp.sqrt(var + eps) * jnp.reshape(scale, shape)
+            + jnp.reshape(bias, shape))
+
+
+@_op("PRelu")
+def _prelu(conv, node, args):
+    import jax.numpy as jnp
+    x, slope = args[0], args[1]
+    if slope.ndim == 1 and x.ndim > 2:   # per-channel: broadcast on C
+        slope = slope.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x > 0, x, x * slope)
+
+
+@_op("HardSigmoid")
+def _hard_sigmoid(conv, node, args):
+    import jax.numpy as jnp
+    a = node.attrs.get("alpha", 0.2)
+    b = node.attrs.get("beta", 0.5)
+    return jnp.clip(a * args[0] + b, 0.0, 1.0)
+
+
+@_op("LogSoftmax")
+def _log_softmax(conv, node, args):
+    import jax
+    x = args[0]
+    if conv.opset >= 13:
+        return jax.nn.log_softmax(x, axis=int(node.attrs.get("axis", -1)))
+    ax = int(node.attrs.get("axis", 1))
+    two_d = x.reshape((int(math.prod(x.shape[:ax])), -1))
+    return jax.nn.log_softmax(two_d, axis=1).reshape(x.shape)
+
+
+@_op("ReduceMax")
+def _reduce_max(conv, node, args):
+    import jax.numpy as jnp
+    axes = node.attrs.get("axes")
+    if axes is None and len(node.inputs) > 1 and node.inputs[1]:
+        axes = [int(a) for a in conv._static_val(node.inputs[1])]
+    return jnp.max(args[0], axis=tuple(axes) if axes else None,
+                   keepdims=bool(node.attrs.get("keepdims", 1)))
+
+
+@_op("ArgMax")
+def _argmax(conv, node, args):
+    import jax.numpy as jnp
+    if int(node.attrs.get("select_last_index", 0)):
+        raise NotImplementedError("ArgMax select_last_index")
+    out = jnp.argmax(args[0], axis=int(node.attrs.get("axis", 0)))
+    # int64 under disabled-x64 downgrades; int32 indexes any real axis
+    if int(node.attrs.get("keepdims", 1)):
+        out = jnp.expand_dims(out, int(node.attrs.get("axis", 0)))
+    return out
+
+
+@_op("Tile")
+def _tile(conv, node, args):
+    import jax.numpy as jnp
+    reps = [int(r) for r in conv._static_val(node.inputs[1])]
+    return jnp.tile(args[0], reps)
+
+
 @_op("Min")
 def _min(conv, node, args):
     import jax.numpy as jnp
