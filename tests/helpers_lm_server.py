@@ -1,0 +1,76 @@
+"""Subprocess LM server for cross-process failover tests: serves a
+fixed-seed dense GenerationEngine (identical weights in every process)
+with paced token emission, prints ``PORT <n>`` when ready, and runs
+until killed.  Companion of tests/test_replica.py's in-process
+``_serve_lm`` — this variant exists so a test can ``SIGKILL`` a real
+process (TCP reset, no grace) rather than call ``shutdown(grace_s=0)``.
+
+    python tests/helpers_lm_server.py [--delay-ms 50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+class PacedEngine:
+    """Delegates to a dense engine, sleeping per emitted token so the
+    parent test can deterministically kill this process MID-stream."""
+
+    def __init__(self, inner, delay_s: float):
+        self._inner, self._delay = inner, delay_s
+
+    def start_session(self, timeout=None):
+        inner_cm = self._inner.start_session(timeout=timeout)
+        delay = self._delay
+
+        @contextlib.contextmanager
+        def cm():
+            with inner_cm as sess:
+                class Paced:
+                    def prefill(self, p):
+                        return sess.prefill(p)
+
+                    def stream(self, steps):
+                        for tok in sess.stream(steps):
+                            time.sleep(delay)
+                            yield tok
+                yield Paced()
+        return cm()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--delay-ms", type=float, default=50.0)
+    args = ap.parse_args()
+
+    from tpulab.tpu.platform import force_cpu
+    force_cpu(1)
+    import jax.numpy as jnp
+
+    import tpulab
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.transformer import init_transformer_params
+
+    params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                     n_layers=2, d_ff=64)  # seed=0 default
+    eng = GenerationEngine(params, n_heads=2, n_layers=2, max_len=64,
+                           max_sessions=2, compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.serve(port=0, generation_engines={
+        "lm": PacedEngine(eng, args.delay_ms / 1e3)})
+    print(f"PORT {mgr.server.bound_port}", flush=True)
+    while True:          # killed by the parent test
+        time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

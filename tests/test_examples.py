@@ -131,6 +131,25 @@ def test_notebook_multiple_models():
     assert "remote == local for both models" in out.stdout
 
 
+def test_notebook_onnx_import():
+    """The ONNX-import walkthrough runs end to end (golden check, int8,
+    portable artifact reload inside)."""
+    if not os.path.isdir("/root/reference/models/onnx/mnist-v1.3"):
+        pytest.skip("reference mnist-v1.3 not present")
+    env = {"PYTHONPATH": REPO, "PATH": "/usr/bin:/bin",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "TPULAB_FORCE_CPU": "1", "HOME": "/tmp"}
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from tpulab.tpu.platform import force_cpu; force_cpu(1);"
+         "import runpy; runpy.run_path("
+         f"'{REPO}/notebooks/onnx_import.py', run_name='__main__')"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "notebook complete" in out.stdout
+    assert "portable artifact reload: OK" in out.stdout
+
+
 def test_grafana_dashboard_matches_exported_metrics():
     """Every metric the dashboard queries must actually be exported
     (the reference dashboard drifted from its exporter; ours must not)."""

@@ -247,6 +247,88 @@ def test_generation_mid_stream_failover_exactly_once():
                 pass
 
 
+def test_generation_failover_across_real_processes():
+    """SIGKILL a real serving PROCESS mid-stream (TCP reset — a harder
+    failure than the in-process shutdown(grace_s=0) test): the set
+    replays on the surviving process and the consumer still sees the
+    exact uninterrupted greedy sequence, exactly once."""
+    import os
+    import signal
+    import subprocess
+    import time
+    import sys as _sys
+
+    import jax.numpy as jnp
+
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.models.transformer import init_transformer_params
+    from tpulab.rpc.replica import GenerationReplicaSet
+
+    repo = __file__.rsplit("/tests/", 1)[0]
+    env = dict(os.environ, PYTHONPATH=repo)
+
+    def spawn():
+        import select
+        proc = subprocess.Popen(
+            [_sys.executable, f"{repo}/tests/helpers_lm_server.py",
+             "--delay-ms", "50"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        deadline = time.monotonic() + 120
+        buf = ""
+        while time.monotonic() < deadline:
+            # select keeps the deadline honest (a silent-but-alive child
+            # must not block readline forever); EOF/death exit early
+            ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if not ready:
+                if proc.poll() is not None:
+                    break
+                continue
+            line = proc.stdout.readline()
+            if line == "":          # EOF: the child died before PORT
+                break
+            buf += line
+            if line.startswith("PORT "):
+                return proc, int(line.split()[1])
+        err = ""
+        if proc.poll() is None:
+            proc.kill()
+        else:
+            err = proc.stderr.read()[-1500:]
+        raise RuntimeError(f"server did not report a port; out={buf[-300:]!r}"
+                           f" err={err!r}")
+
+    procs = []
+    grs = None
+    try:
+        procs = [spawn(), spawn()]
+        addrs = [f"127.0.0.1:{port}" for _, port in procs]
+        # the same fixed-seed weights the helpers serve
+        params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                         n_layers=2, d_ff=64)
+        eng = GenerationEngine(params, n_heads=2, n_layers=2, max_len=64,
+                               compute_dtype=jnp.float32)
+        prompt = np.arange(5, dtype=np.int32)
+        steps = 20
+        expected = list(eng.generate(prompt[None, :], steps)[0])
+
+        grs = GenerationReplicaSet(addrs, "lm")
+        it = grs.generate(prompt, steps)
+        got = [next(it) for _ in range(3)]
+        active = grs.inflight.index(1)
+        os.kill(procs[active][0].pid, signal.SIGKILL)  # a real crash
+        got += list(it)
+        assert got == expected, (got, expected)
+        assert grs.served[1 - active] == 1, grs.served
+    finally:
+        if grs is not None:
+            grs.close()
+        for proc, _ in procs:
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=30)
+
+
 def test_generation_seed_injected_for_sampled_requests():
     """Sampling without a seed gets a client-side one (replay
     determinism); greedy and explicitly-seeded requests pass through."""

@@ -930,6 +930,7 @@ def _resize(conv, node, args):
         # coincides at the integer scale factors upsamplers use
         raise NotImplementedError(f"Resize nearest_mode={nm}")
     sizes = scales = None
+    legacy = False       # Upsample-7/9 & Resize-10: asymmetric transform
     if len(node.inputs) >= 4 and node.inputs[3]:
         # opset 11+: X, roi, scales, sizes (scales/sizes must be static)
         sizes = [int(s) for s in conv._static_val(node.inputs[3])]
@@ -940,15 +941,42 @@ def _resize(conv, node, args):
     elif len(node.inputs) == 2 and node.inputs[1]:
         # opset 9/10 (Upsample-9, Resize-10): X, scales
         scales = [float(s) for s in conv._static_val(node.inputs[1])]
+        legacy = True
     elif "scales" in node.attrs:                  # Upsample-7 attribute
         scales = [float(s) for s in node.attrs["scales"]]
+        legacy = True
     if sizes is None:
         if scales is None:
             raise NotImplementedError("Resize without scales/sizes")
         # spec: output dim = floor(input dim * scale)
         sizes = [int(math.floor(d * s)) for d, s in zip(x.shape, scales)]
+    elif (sizes[0] == 1 and x.shape[0] != 1
+          and tuple(sizes[1:2]) == tuple(x.shape[1:2])):
+        # sizes-form exports bake the N=1 batch like Reshape targets do:
+        # rebind to the traced bucket so one import serves every bucket
+        sizes = [int(x.shape[0])] + sizes[1:]
     if tuple(sizes[:2]) != tuple(x.shape[:2]):
         raise NotImplementedError("Resize over batch/channel dims")
+    if any(o < i for o, i in zip(sizes[2:], x.shape[2:])):
+        # jax.image.resize antialiases on downscale (ONNX default does
+        # not) and its nearest tie-break diverges below 1x — wrong
+        # values, so refuse rather than miscompute
+        raise NotImplementedError("Resize downscale (antialias semantics "
+                                  "differ from the ONNX default)")
+    integer_up = all(o % i == 0 for o, i in zip(sizes[2:], x.shape[2:]))
+    if legacy:
+        # asymmetric coordinate transform: equals the half-pixel result
+        # only for nearest at integer scale factors — the one case the
+        # legacy Upsample family is actually used for
+        if mode != "nearest" or not integer_up:
+            raise NotImplementedError(
+                "legacy Upsample/Resize-10 (asymmetric transform) is "
+                "supported only for nearest at integer scale factors")
+    elif mode == "nearest" and not integer_up:
+        # at fractional factors round_prefer_floor and jax's tie-break
+        # pick different source pixels — refuse rather than miscompute
+        raise NotImplementedError(
+            "nearest Resize at non-integer scale factors")
     method = {"nearest": "nearest", "linear": "bilinear",
               "cubic": "bicubic"}.get(mode)
     if method is None:
