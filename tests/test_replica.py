@@ -301,7 +301,8 @@ def test_generation_failover_across_real_processes():
     procs = []
     grs = None
     try:
-        procs = [spawn(), spawn()]
+        for _ in range(2):   # sequential appends: a failed second spawn
+            procs.append(spawn())  # must not orphan the first server
         addrs = [f"127.0.0.1:{port}" for _, port in procs]
         # the same fixed-seed weights the helpers serve
         params = init_transformer_params(vocab=64, d_model=32, n_heads=2,

@@ -93,3 +93,62 @@ def test_w8a16_quantization_applies():
     assert np.all(np.isfinite(b))
     corr = float(np.corrcoef(a.ravel(), b.ravel())[0, 1])
     assert corr > 0.98, corr
+
+
+def test_hf_vit_import_matches_transformers_forward():
+    """Cross-framework golden check: a tiny HF ViTForImageClassification
+    (random init, eval mode) forwarded in torch vs the same state_dict
+    imported through vit_params_from_hf and run by vit_apply — the two
+    implementations must agree numerically (the classic-dialect path:
+    LayerNorm+bias, biased projections, exact gelu)."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    import jax.numpy as jnp
+
+    from tpulab.models.torch_import import make_vit_from_hf
+
+    cfg = transformers.ViTConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=64, image_size=16, patch_size=8, num_labels=5,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(0)
+    hf = transformers.ViTForImageClassification(cfg).eval()
+    x = torch.randn(2, 3, 16, 16)
+    with torch.no_grad():
+        want = hf(pixel_values=x).logits.numpy()
+
+    model = make_vit_from_hf(hf.state_dict(), image_size=16, patch_size=8,
+                             n_heads=2, layer_norm_eps=cfg.layer_norm_eps,
+                             compute_dtype=jnp.float32, max_batch_size=2)
+    got = np.asarray(model.apply_fn(
+        model.params, {"input": x.numpy().transpose(0, 2, 3, 1)})["logits"])
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_hf_vit_import_serves_through_engine():
+    """The imported checkpoint behind the full serving pipeline."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+
+    import tpulab
+    from tpulab.models.torch_import import make_vit_from_hf
+
+    cfg = transformers.ViTConfig(
+        hidden_size=32, num_hidden_layers=1, num_attention_heads=2,
+        intermediate_size=64, image_size=16, patch_size=8, num_labels=3)
+    torch.manual_seed(1)
+    hf = transformers.ViTForImageClassification(cfg).eval()
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("vit_hf", make_vit_from_hf(
+        hf.state_dict(), image_size=16, patch_size=8, n_heads=2,
+        max_batch_size=2))
+    mgr.update_resources()
+    try:
+        x = np.random.default_rng(0).standard_normal(
+            (2, 16, 16, 3)).astype(np.float32)
+        out = mgr.infer_runner("vit_hf").infer(input=x).result(timeout=120)
+        assert out["logits"].shape == (2, 3)
+        assert np.all(np.isfinite(out["logits"]))
+    finally:
+        mgr.shutdown()

@@ -142,3 +142,116 @@ def llama_params_from_torch(state_dict: Mapping[str, Any],
     import jax
     import jax.numpy as jnp
     return jax.tree_util.tree_map(jnp.asarray, params)
+
+
+# --------------------------------------------------------------------------
+# ViT import (HF ViTForImageClassification state_dict -> tpulab vit)
+# --------------------------------------------------------------------------
+
+def vit_params_from_hf(state_dict: Mapping[str, Any],
+                       layer_norm_eps: float = 1e-12,
+                       image_mean=(0.5, 0.5, 0.5),
+                       image_std=(0.5, 0.5, 0.5)) -> Dict[str, Any]:
+    """HF ``ViTForImageClassification`` state_dict -> tpulab vit params.
+
+    The in-house ViT is RMSNorm/bias-free (TPU-first defaults); imported
+    checkpoints keep their classic dialect faithfully — LayerNorm with
+    bias (+ the config's eps), biased projections, exact erf-gelu — all
+    selected inside :func:`tpulab.models.vit.vit_apply` by the presence
+    of the bias entries this importer writes.  The patch-embedding
+    Conv2d(C, D, p, stride=p) becomes the patchify matmul's
+    (p*p*C, D) weight (kernel transposed (kh, kw, C) -> row order,
+    matching vit_apply's (p_h, p_w, c) patch flattening).
+    """
+    sd = state_dict
+    n_layers = len({k.split(".")[3] for k in sd
+                    if k.startswith("vit.encoder.layer.")})
+    proj = _np(sd["vit.embeddings.patch_embeddings.projection.weight"])
+    eps = np.float32(layer_norm_eps)
+    params: Dict[str, Any] = {
+        "cls": _np(sd["vit.embeddings.cls_token"]).reshape(-1),
+        "pos_embed": _np(sd["vit.embeddings.position_embeddings"])[0],
+        # (D, C, p, p) -> (p, p, C, D) -> (p*p*C, D)
+        "patch_embed": np.transpose(proj, (2, 3, 1, 0)).reshape(
+            -1, proj.shape[0]),
+        "patch_bias": _np(
+            sd["vit.embeddings.patch_embeddings.projection.bias"]),
+        "final_norm": {"scale": _np(sd["vit.layernorm.weight"]),
+                       "bias": _np(sd["vit.layernorm.bias"]),
+                       "eps": eps},
+        "head": {"kernel": _np(sd["classifier.weight"]).T,
+                 "bias": _np(sd["classifier.bias"])},
+        # uint8-ingress normalization: the checkpoint PROCESSOR's stats
+        # (HF ViT default mean/std = 0.5), NOT the imagenet defaults
+        "norm_mean": np.asarray(image_mean, np.float32),
+        "norm_std": np.asarray(image_std, np.float32),
+    }
+    for i in range(n_layers):
+        pre = f"vit.encoder.layer.{i}"
+        att = f"{pre}.attention.attention"
+        params[f"layer{i}"] = {
+            "ln1": {"scale": _np(sd[f"{pre}.layernorm_before.weight"]),
+                    "bias": _np(sd[f"{pre}.layernorm_before.bias"]),
+                    "eps": eps},
+            "ln2": {"scale": _np(sd[f"{pre}.layernorm_after.weight"]),
+                    "bias": _np(sd[f"{pre}.layernorm_after.bias"]),
+                    "eps": eps},
+            "wqkv": np.concatenate(
+                [_np(sd[f"{att}.{n}.weight"]).T
+                 for n in ("query", "key", "value")], axis=1),
+            "bqkv": np.concatenate(
+                [_np(sd[f"{att}.{n}.bias"])
+                 for n in ("query", "key", "value")]),
+            "wo": _np(sd[f"{pre}.attention.output.dense.weight"]).T,
+            "bo": _np(sd[f"{pre}.attention.output.dense.bias"]),
+            "w1": _np(sd[f"{pre}.intermediate.dense.weight"]).T,
+            "b1": _np(sd[f"{pre}.intermediate.dense.bias"]),
+            "w2": _np(sd[f"{pre}.output.dense.weight"]).T,
+            "b2": _np(sd[f"{pre}.output.dense.bias"]),
+        }
+    return params
+
+
+def make_vit_from_hf(state_dict_or_path, *, image_size: int,
+                     patch_size: int, n_heads: int,
+                     layer_norm_eps: float = 1e-12, **make_kwargs):
+    """Servable ViT from an HF checkpoint (path or state_dict).  Geometry
+    (image/patch/heads) comes from the HF config — pass it explicitly,
+    like :func:`llama_params_from_torch`'s serve-time contract."""
+    if isinstance(state_dict_or_path, (str, bytes)):
+        import torch
+        state_dict = torch.load(state_dict_or_path, map_location="cpu",
+                                weights_only=True)
+    else:
+        state_dict = state_dict_or_path
+    params = vit_params_from_hf(state_dict, layer_norm_eps)
+    n_layers = len([k for k in params if k.startswith("layer")])
+    d_model = params["patch_embed"].shape[1]
+    num_classes = params["head"]["bias"].shape[0]
+
+    from functools import partial
+
+    from tpulab.engine.model import IOSpec, Model
+    from tpulab.models.vit import vit_apply
+    import jax.numpy as jnp
+
+    apply_fn = partial(vit_apply, n_heads=n_heads, n_layers=n_layers,
+                       patch_size=patch_size,
+                       compute_dtype=make_kwargs.pop("compute_dtype",
+                                                     jnp.bfloat16))
+    expect = (image_size // patch_size) ** 2 + 1
+    if params["pos_embed"].shape[0] != expect:
+        raise ValueError(
+            f"image_size {image_size}/patch {patch_size} implies "
+            f"{expect} positions but the checkpoint has "
+            f"{params['pos_embed'].shape[0]}")
+    if d_model % n_heads:
+        raise ValueError(f"d_model {d_model} not divisible by "
+                         f"n_heads {n_heads}")
+    return Model(
+        name=make_kwargs.pop("name", f"vit_hf_{patch_size}"),
+        apply_fn=apply_fn, params=params,
+        inputs=[IOSpec("input", (image_size, image_size, 3),
+                       make_kwargs.pop("input_dtype", np.float32))],
+        outputs=[IOSpec("logits", (num_classes,), np.float32)],
+        **make_kwargs)

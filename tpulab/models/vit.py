@@ -80,24 +80,62 @@ def init_vit_params(variant: str = "s", image_size: int = 224,
     return params
 
 
+def _norm(x, np_, compute_dtype):
+    """RMSNorm (in-house layout: {scale}) or classic LayerNorm when the
+    checkpoint carries a bias ({scale, bias} — the HF/torchvision
+    family): one predicate keys the faithful-import path."""
+    if "bias" in np_:
+        eps = np_.get("eps", 1e-6)  # HF stores its config eps (1e-12)
+        # statistics in f32 (the strongly-typed eps promotes them — good:
+        # bf16 LN stats lose precision); the OUTPUT drops back to
+        # compute_dtype so the promotion never leaks into the matmuls
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mu), -1, keepdims=True)
+        xn = (x - mu) * jax.lax.rsqrt(var + eps)
+        return (xn * np_["scale"].astype(compute_dtype)
+                + np_["bias"].astype(compute_dtype)).astype(compute_dtype)
+    return _rmsnorm(x, np_["scale"].astype(compute_dtype))
+
+
+def _badd(h, lp, key, compute_dtype):
+    b = lp.get(key)
+    return h if b is None else h + b.astype(compute_dtype)
+
+
 def vit_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
               n_heads: int, n_layers: int, patch_size: int = 16,
               compute_dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
     """Forward: NHWC image -> logits (binding names: input / logits).
-    uint8 inputs are normalized on device, like the ResNet serving path."""
+    uint8 inputs are normalized on device, like the ResNet serving path.
+
+    Two weight dialects share this function: the in-house init (RMSNorm,
+    bias-free, tanh-gelu — the TPU-first default) and faithfully
+    imported classic checkpoints (HF ViT: LayerNorm with bias, biased
+    projections, exact erf-gelu).  Bias presence in the norm dicts picks
+    the dialect — the checkpoint defines the function, no flags to
+    mismatch."""
     x = inputs["input"]
     if x.dtype == jnp.uint8:
-        mean = jnp.asarray(IMAGENET_MEAN, compute_dtype) * 255.0
-        std = jnp.asarray(IMAGENET_STD, compute_dtype) * 255.0
+        # imported checkpoints carry their processor's normalization
+        # (HF ViT uses mean=std=0.5, NOT the imagenet stats)
+        mean = params.get("norm_mean")
+        std = params.get("norm_std")
+        mean = (jnp.asarray(IMAGENET_MEAN, compute_dtype) if mean is None
+                else mean.astype(compute_dtype)) * 255.0
+        std = (jnp.asarray(IMAGENET_STD, compute_dtype) if std is None
+               else std.astype(compute_dtype)) * 255.0
         x = (x.astype(compute_dtype) - mean) / std
     else:
         x = x.astype(compute_dtype)
+    classic = "bias" in params["final_norm"]
     b, hh, ww, c = x.shape
     p = patch_size
     # patchify = pure layout: (B, Hp, p, Wp, p, C) -> (B, N, p*p*C)
     x = x.reshape(b, hh // p, p, ww // p, p, c).transpose(0, 1, 3, 2, 4, 5)
     x = x.reshape(b, (hh // p) * (ww // p), p * p * c)
     x = x @ qmat(params["patch_embed"], compute_dtype)
+    if "patch_bias" in params:
+        x = x + params["patch_bias"].astype(compute_dtype)
     cls = jnp.broadcast_to(params["cls"].astype(compute_dtype),
                            (b, 1, x.shape[-1]))
     x = jnp.concatenate([cls, x], axis=1)
@@ -106,16 +144,20 @@ def vit_apply(params: Dict[str, Any], inputs: Dict[str, jnp.ndarray],
     head_dim = d_model // n_heads
     for i in range(n_layers):
         lp = params[f"layer{i}"]
-        h = _rmsnorm(x, lp["ln1"]["scale"].astype(compute_dtype))
-        qkv = h @ qmat(lp["wqkv"], compute_dtype)
+        h = _norm(x, lp["ln1"], compute_dtype)
+        qkv = _badd(h @ qmat(lp["wqkv"], compute_dtype), lp, "bqkv",
+                    compute_dtype)
         q, k, v = (qkv[..., j * d_model:(j + 1) * d_model]
                    .reshape(b, t, n_heads, head_dim) for j in range(3))
         attn = dense_attention(q, k, v, causal=False).reshape(b, t, d_model)
-        x = x + attn @ qmat(lp["wo"], compute_dtype)
-        h = _rmsnorm(x, lp["ln2"]["scale"].astype(compute_dtype))
-        x = x + (jax.nn.gelu(h @ qmat(lp["w1"], compute_dtype))
-                 @ qmat(lp["w2"], compute_dtype)).astype(x.dtype)
-    x = _rmsnorm(x, params["final_norm"]["scale"].astype(compute_dtype))
+        x = x + _badd(attn @ qmat(lp["wo"], compute_dtype), lp, "bo",
+                      compute_dtype)
+        h = _norm(x, lp["ln2"], compute_dtype)
+        h = _badd(h @ qmat(lp["w1"], compute_dtype), lp, "b1", compute_dtype)
+        h = jax.nn.gelu(h, approximate=not classic)
+        x = x + _badd(h @ qmat(lp["w2"], compute_dtype), lp, "b2",
+                      compute_dtype).astype(x.dtype)
+    x = _norm(x, params["final_norm"], compute_dtype)
     logits = (x[:, 0].astype(jnp.float32) @ params["head"]["kernel"]
               + params["head"]["bias"])
     return {"logits": logits}
