@@ -49,6 +49,8 @@ class GenerationEngine:
         from tpulab.models.transformer import weight_shape
         d_model = weight_shape(params["layer0"]["wqkv"])[0]
         self.head_dim = d_model // n_heads
+        #: id-validation bound (public: the Generate RPC checks it)
+        self.vocab = int(weight_shape(params["embed"])[0])
 
         self._decode = jax.jit(partial(
             transformer_decode_step, n_heads=n_heads, n_layers=n_layers,

@@ -710,6 +710,8 @@ class ContinuousBatcher:
         self.max_pages = (max_len + page_size - 1) // page_size
         from tpulab.models.transformer import weight_shape
         d_model = weight_shape(params["layer0"]["wqkv"])[0]
+        #: id-validation bound (public: the Generate RPC checks it too)
+        self.vocab = int(weight_shape(params["embed"])[0])
         # +1: page 0 is the reserved scratch page.  GQA pools store the
         # compact n_kv_heads form — KV HBM shrinks by n_heads/n_kv_heads.
         self._owns_pool = pool is None
@@ -826,13 +828,18 @@ class ContinuousBatcher:
         one evicts it — the victim's pages free immediately and it resumes
         later by re-prefilling prompt+generated (exact-token resume; with a
         prefix cache the recompute mostly hits cached pages)."""
-        n_prompt = len(np.asarray(prompt).reshape(-1))
+        flat = np.asarray(prompt).reshape(-1)
+        n_prompt = len(flat)
         if n_prompt == 0:
             raise ValueError("empty prompt")
         if steps < 1:
             raise ValueError("steps must be >= 1")
         if n_prompt + steps > self.max_len:
             raise ValueError(f"prompt+steps exceeds max_len {self.max_len}")
+        if flat.min() < 0 or flat.max() >= self.vocab:
+            # XLA gather CLAMPS out-of-bounds ids — silent garbage tokens;
+            # reject at the host boundary instead
+            raise ValueError(f"prompt token ids outside [0, {self.vocab})")
         req = _PagedRequest(prompt, steps, on_token=on_token,
                             sampling=sampling, priority=priority,
                             stop_tokens=stop_tokens, logprobs=logprobs)

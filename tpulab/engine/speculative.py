@@ -52,6 +52,8 @@ class SpeculativeGenerator:
         self.device = device if device is not None else plat.local_device(0)
         cdt = compute_dtype or jnp.float32
         self._jnp = jnp
+        #: id-validation bound (public: the Generate RPC checks it)
+        self.vocab = int(target_params["embed"].shape[0])
         self.target_params = jax.device_put(target_params, self.device)
         self.draft_params = jax.device_put(draft_params, self.device)
 
@@ -377,6 +379,10 @@ class SpeculativeSessionEngine:
     def _count_completion(self) -> None:
         with self._count_lock:
             self.completed_requests += 1
+
+    @property
+    def vocab(self):
+        return self._spec.vocab
 
     #: telemetry passthrough (last finished call)
     @property

@@ -202,9 +202,12 @@ class InferContext(Context):
                 if tuple(arr.shape[1:]) != s.shape:
                     raise ValueError(f"input {s.name} shape {arr.shape[1:]} "
                                      f"!= {s.shape}")
-                if arr.shape[0] > model.max_batch_size:
-                    raise ValueError(f"batch {arr.shape[0]} exceeds "
-                                     f"max_batch_size {model.max_batch_size}")
+                if not 1 <= arr.shape[0] <= model.max_batch_size:
+                    # <1 catches the dims=[-1,...]+empty-payload lie that
+                    # reshapes to batch 0 and would "succeed" vacuously
+                    raise ValueError(
+                        f"batch {arr.shape[0]} outside [1, "
+                        f"{model.max_batch_size}]")
             output_names = {s.name for s in model.outputs}
             unknown = set(request.requested_outputs) - output_names
             if unknown:
@@ -470,6 +473,16 @@ class GenerateContext(StreamingContext):
                 code=pb.INVALID_ARGUMENT,
                 message="temperature must be >= 0")))
             return
+        # shared host-boundary id validation (XLA gather CLAMPS
+        # out-of-bounds ids — silent garbage): every engine kind exposes
+        # its vocab bound, so the check covers dense/paged/speculative
+        vocab = getattr(engine, "vocab", None)
+        ids = np.asarray(request.prompt, np.int64)
+        if vocab and ids.size and (ids.min() < 0 or ids.max() >= vocab):
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT,
+                message=f"prompt token ids outside [0, {vocab})")))
+            return
         if getattr(engine, "continuous_batching", False):  # explicit marker
             self._run_paged(engine, request)
             return
@@ -500,6 +513,13 @@ class GenerateContext(StreamingContext):
                         break  # stop token emitted; end like the paged path
             self.write(pb.GenerateResponse(
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
+        except ValueError as e:
+            # deterministic request errors (length/steps/id bounds): the
+            # same on every replica — INVALID_ARGUMENT so routers don't
+            # fail the identical doomed request over (GenerationRejected
+            # retryable contract)
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT, message=str(e))))
         except Exception as e:  # noqa: BLE001
             log.exception("generation failed")
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
@@ -553,6 +573,13 @@ class GenerateContext(StreamingContext):
             finished[0] = True
             self.write(pb.GenerateResponse(
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
+        except ValueError as e:
+            # submit()'s deterministic request validation (empty prompt,
+            # steps, max_len, id bounds): INVALID_ARGUMENT, not INTERNAL —
+            # GenerationRejected.retryable must not fail these over
+            finished[0] = True
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT, message=str(e))))
         except Exception as e:  # noqa: BLE001
             finished[0] = True
             if fut is not None:
