@@ -342,6 +342,59 @@ def test_parser_rejects_malformed_bytes():
         assert isinstance(e, (ValueError, IndexError)), e
 
 
+def test_external_data_tensors(tmp_path):
+    """data_location=EXTERNAL initializers (how >2 GB zoo models ship
+    weights) load from the sidecar file at offset/length; escaping
+    locations are rejected."""
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    pad, payload = b"\x7f" * 16, w.tobytes()
+    (tmp_path / "weights.bin").write_bytes(pad + payload)
+
+    def ext_tensor(name, arr, location, offset, length):
+        def entry(k, v):
+            return _ld(1, k.encode()) + _ld(2, v.encode())
+        out = b"".join(_vint(1, d) for d in arr.shape)
+        out += _vint(2, 1) + _ld(8, name.encode())
+        out += _ld(13, entry("location", location))
+        out += _ld(13, entry("offset", str(offset)))
+        out += _ld(13, entry("length", str(length)))
+        out += _vint(14, 1)  # data_location = EXTERNAL
+        return out
+
+    def model_with(location):
+        g = _ld(1, _node("Identity", ["w"], ["y"]))
+        g += _ld(5, ext_tensor("w", w, location, len(pad), len(payload)))
+        g += _ld(12, _value_info("y", [3, 4]))
+        return _vint(1, 7) + _ld(7, g) + _ld(8, _ld(1, b"") + _vint(2, 13))
+
+    p = tmp_path / "ext.onnx"
+    p.write_bytes(model_with("weights.bin"))
+    m = load_onnx_model(str(p), max_batch_size=1)
+    np.testing.assert_array_equal(np.asarray(m.params["w"]), w)
+    out = m.apply_fn(m.params, {})
+    np.testing.assert_array_equal(np.asarray(out["y"]), w)
+    # path traversal out of the model dir is refused
+    p2 = tmp_path / "evil.onnx"
+    p2.write_bytes(model_with("../weights.bin"))
+    with pytest.raises(ValueError, match="escapes"):
+        parse_onnx(str(p2))
+    # ...but a filename that merely BEGINS with dots is legitimate
+    (tmp_path / "..weights.bin").write_bytes(pad + payload)
+    p3 = tmp_path / "dots.onnx"
+    p3.write_bytes(model_with("..weights.bin"))
+    m3 = load_onnx_model(str(p3), max_batch_size=1)
+    np.testing.assert_array_equal(np.asarray(m3.params["w"]), w)
+    # byte-level parse (no path context) names the problem
+    with pytest.raises(ValueError, match="externally"):
+        OnnxModel(model_with("weights.bin"))
+    # preflight mode inventories the sidecar WITHOUT reading it
+    sidecars = []
+    om = parse_onnx(str(p), collect_external=sidecars)
+    assert [e["location"] for e in sidecars] == ["weights.bin"]
+    assert om.graph.initializers["w"].shape == w.shape  # placeholder
+    assert not om.graph.initializers["w"].any()
+
+
 def test_onnx_weight_only_int8(tmp_path):
     """weight_quant="int8" on an imported graph: eligible Conv/Gemm
     weights become {w_int8, scale} (per-channel for OIHW), ineligible

@@ -25,6 +25,36 @@ def test_config_generator_cli():
     assert cfg["dynamic_batching"]["preferred_batch_size"]
 
 
+def test_onnx_summary_cli(tmp_path):
+    """Import preflight: supported model reports importable (rc 0);
+    a model with an unregistered op reports it and exits 2."""
+    ref = "/root/reference/models/onnx/mnist-v1.3/model.onnx"
+    if os.path.exists(ref):
+        out = subprocess.run(
+            [sys.executable, f"{REPO}/tools/onnx_summary.py", ref],
+            capture_output=True, text=True, timeout=240, env=ENV)
+        assert out.returncode == 0, out.stderr[-2000:]
+        rep = json.loads(out.stdout)
+        assert rep["importable"] and rep["op_histogram"]["Conv"] == 2
+        assert rep["inputs"][0]["name"] == "Input3"
+    sys.path.insert(0, f"{REPO}/tests")
+    try:
+        from test_onnx_import import _model_bytes, _node
+    finally:
+        sys.path.pop(0)
+    p = tmp_path / "weird.onnx"
+    p.write_bytes(_model_bytes(
+        [_node("NonMaxSuppression", ["x"], ["y"])], {},
+        [("x", [1, 4])], [("y", [1, 4])]))
+    out = subprocess.run(
+        [sys.executable, f"{REPO}/tools/onnx_summary.py", str(p)],
+        capture_output=True, text=True, timeout=240, env=ENV)
+    assert out.returncode == 2
+    rep = json.loads(out.stdout)
+    assert rep["unsupported_ops"] == ["NonMaxSuppression"]
+    assert rep["importable"] is False
+
+
 def test_build_engine_cli_roundtrip(tmp_path):
     """build -> artifact dir -> loadable engine serving inferences."""
     out = subprocess.run(

@@ -24,6 +24,7 @@ no compiler-visible gain.
 from __future__ import annotations
 
 import math
+import os
 import struct
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -113,15 +114,60 @@ _DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
            11: np.float64, 12: np.uint32, 13: np.uint64}
 
 
-def _decode_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+def _decode_tensor(buf: bytes, base_dir: Optional[str] = None,
+                   collect_external: Optional[list] = None
+                   ) -> Tuple[str, np.ndarray]:
     """TensorProto -> (name, ndarray).  Fields: dims=1 data_type=2
     float_data=4 int32_data=5 string_data=6 int64_data=7 name=8 raw_data=9
-    double_data=10 uint64_data=11."""
+    double_data=10 uint64_data=11 external_data=13 data_location=14.
+
+    ``data_location=EXTERNAL`` tensors (how >2 GB zoo models ship their
+    weights) load from the sidecar file named in external_data
+    (StringStringEntryProto key=1 value=2: location/offset/length),
+    resolved against ``base_dir`` — the model.onnx's directory.  With
+    ``collect_external`` (a list) the sidecar is NOT read: metadata is
+    appended and a zeros placeholder of the right shape/dtype returned —
+    the preflight mode (tools/onnx_summary.py)."""
     g = _group(buf)
     dims = _packed_varints(g.get(1, []))
     dt = _packed_varints(g.get(2, []))
     dtype = np.dtype(_DTYPES[dt[0] if dt else 1])
     name = g[8][0][1].decode() if 8 in g else ""
+    loc = _packed_varints(g.get(14, []))
+    if loc and loc[0] == 1:  # EXTERNAL
+        info = {}
+        for _, entry in g.get(13, []):
+            eg = _group(entry)
+            k = eg[1][0][1].decode() if 1 in eg else ""
+            v = eg[2][0][1].decode() if 2 in eg else ""
+            info[k] = v
+        if "location" not in info:
+            raise ValueError(f"external tensor {name!r} without location")
+        if collect_external is not None:
+            collect_external.append(dict(info, tensor=name))
+            return name, np.zeros(dims or [0], dtype)
+        if base_dir is None:
+            raise ValueError(
+                f"tensor {name!r} stores its data externally "
+                f"({info['location']}); parse from a file path so the "
+                "sidecar can be resolved")
+        rel = os.path.normpath(info["location"])
+        if rel == ".." or rel.startswith("../") or os.path.isabs(rel):
+            # stay inside the model dir ("..weights.bin" is a legal name)
+            raise ValueError(f"external data path escapes model dir: "
+                             f"{info['location']!r}")
+        path = os.path.join(base_dir, rel)
+        offset = int(info.get("offset", 0))
+        length = int(info.get("length",
+                              int(np.prod(dims or [1])) * dtype.itemsize))
+        with open(path, "rb") as f:
+            f.seek(offset)
+            raw = f.read(length)
+        if len(raw) != length:
+            raise ValueError(f"external tensor {name!r}: wanted {length} "
+                             f"bytes at {offset}, got {len(raw)}")
+        arr = np.frombuffer(raw, dtype=dtype)
+        return name, arr.reshape(dims) if dims else arr
     if 9 in g:  # raw_data: little-endian, C order (the common zoo encoding)
         raw = b"".join(v for _, v in g[9])
         arr = np.frombuffer(raw, dtype=dtype)
@@ -158,7 +204,8 @@ def _decode_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
     return name, arr.reshape(dims) if dims else arr
 
 
-def _decode_attr(buf: bytes) -> Tuple[str, Any]:
+def _decode_attr(buf: bytes, base_dir: Optional[str] = None,
+                 collect_external: Optional[list] = None) -> Tuple[str, Any]:
     """AttributeProto: name=1 f=2 i=3 s=4 t=5 g=6 floats=7 ints=8
     strings=9 (type=20 is redundant with which field is set)."""
     g = _group(buf)
@@ -170,7 +217,8 @@ def _decode_attr(buf: bytes) -> Tuple[str, Any]:
     if 4 in g:
         return name, g[4][0][1]  # bytes
     if 5 in g:
-        return name, _decode_tensor(g[5][0][1])[1]
+        return name, _decode_tensor(g[5][0][1], base_dir,
+                                    collect_external)[1]
     if 7 in g:
         vals = []
         for wt, v in g[7]:
@@ -192,13 +240,15 @@ def _decode_attr(buf: bytes) -> Tuple[str, Any]:
 class OnnxNode:
     __slots__ = ("op", "name", "inputs", "outputs", "attrs")
 
-    def __init__(self, buf: bytes):
+    def __init__(self, buf: bytes, base_dir: Optional[str] = None,
+                 collect_external: Optional[list] = None):
         g = _group(buf)  # input=1 output=2 name=3 op_type=4 attribute=5
         self.inputs = [v.decode() for _, v in g.get(1, [])]
         self.outputs = [v.decode() for _, v in g.get(2, [])]
         self.name = g[3][0][1].decode() if 3 in g else ""
         self.op = g[4][0][1].decode() if 4 in g else ""
-        self.attrs = dict(_decode_attr(v) for _, v in g.get(5, []))
+        self.attrs = dict(_decode_attr(v, base_dir, collect_external)
+                          for _, v in g.get(5, []))
 
 
 def _decode_value_info(buf: bytes) -> Tuple[str, Optional[np.dtype],
@@ -225,12 +275,15 @@ def _decode_value_info(buf: bytes) -> Tuple[str, Optional[np.dtype],
 class OnnxGraph:
     """Parsed GraphProto: node=1 name=2 initializer=5 input=11 output=12."""
 
-    def __init__(self, buf: bytes):
+    def __init__(self, buf: bytes, base_dir: Optional[str] = None,
+                 collect_external: Optional[list] = None):
         g = _group(buf)
         self.name = g[2][0][1].decode() if 2 in g else "onnx"
-        self.nodes = [OnnxNode(v) for _, v in g.get(1, [])]
+        self.nodes = [OnnxNode(v, base_dir, collect_external)
+                      for _, v in g.get(1, [])]
         self.initializers: Dict[str, np.ndarray] = dict(
-            _decode_tensor(v) for _, v in g.get(5, []))
+            _decode_tensor(v, base_dir, collect_external)
+            for _, v in g.get(5, []))
         self.inputs = [_decode_value_info(v) for _, v in g.get(11, [])]
         self.outputs = [_decode_value_info(v) for _, v in g.get(12, [])]
 
@@ -239,7 +292,8 @@ class OnnxModel:
     """Parsed ModelProto: ir_version=1 producer_name=2 graph=7
     opset_import=8 (OperatorSetIdProto: domain=1 version=2)."""
 
-    def __init__(self, data: bytes):
+    def __init__(self, data: bytes, base_dir: Optional[str] = None,
+                 collect_external: Optional[list] = None):
         g = _group(data)
         self.ir_version = g[1][0][1] if 1 in g else 0
         self.producer = g[2][0][1].decode() if 2 in g else ""
@@ -251,13 +305,14 @@ class OnnxModel:
                 self.opset = max(self.opset, os_g[2][0][1])
         if 7 not in g:
             raise ValueError("ModelProto has no graph")
-        self.graph = OnnxGraph(g[7][0][1])
+        self.graph = OnnxGraph(g[7][0][1], base_dir, collect_external)
 
 
 def load_tensor_pb(path: str) -> np.ndarray:
     """A bare serialized TensorProto (the zoo's test_data_set vectors)."""
     with open(path, "rb") as f:
-        return _decode_tensor(f.read())[1]
+        return _decode_tensor(f.read(), os.path.dirname(
+            os.path.abspath(path)))[1]
 
 
 # --------------------------------------------------------------------------
@@ -435,6 +490,15 @@ def _wval(w):
 
 # op implementations -- each: (conv: _Converter, node, args) -> array | tuple
 _OPS: Dict[str, Callable] = {}
+
+#: evaluator-special-cased ops (not in _OPS): Shape seeds the shape pool,
+#: Constant prefolds.  supported_ops() is the public "can I import this"
+#: answer (tools/onnx_summary.py) — keep it, not callers, in sync.
+_EVALUATOR_SPECIAL = frozenset({"Shape", "Constant"})
+
+
+def supported_ops() -> frozenset:
+    return frozenset(_OPS) | _EVALUATOR_SPECIAL
 
 
 def _op(name: str):
@@ -1077,9 +1141,14 @@ def _max(conv, node, args):
 # --------------------------------------------------------------------------
 
 
-def parse_onnx(path: str) -> OnnxModel:
+def parse_onnx(path: str,
+               collect_external: Optional[list] = None) -> OnnxModel:
+    """Parse a model file.  ``collect_external`` switches to preflight
+    mode: external sidecars are inventoried, not read (see
+    :func:`_decode_tensor`)."""
     with open(path, "rb") as f:
-        return OnnxModel(f.read())
+        return OnnxModel(f.read(), os.path.dirname(os.path.abspath(path)),
+                         collect_external)
 
 
 def load_onnx_model(path: str, name: Optional[str] = None,
