@@ -206,7 +206,13 @@ def _load_last_good() -> dict | None:
 
 
 def _latest_degraded_record() -> dict | None:
-    """Most recent prior CPU-fallback round record (for the CPU trend)."""
+    """Most recent PRIOR CPU-fallback round record (for the CPU trend).
+
+    Records stamped with the CURRENT round are excluded (ADVICE r5): a
+    re-run would otherwise compare against its own round's earlier file
+    (delta ~0) and mask a real regression vs the previous round."""
+    cur = os.environ.get("TPULAB_BENCH_ROUND")
+    cur_round = int(cur) if cur and cur.isdigit() else None
     best = None
     for p in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
         try:
@@ -221,6 +227,8 @@ def _latest_degraded_record() -> dict | None:
             if float(rec.get("value", 0) or 0) <= 0:
                 continue
             rec.setdefault("source_file", os.path.basename(p))
+            if cur_round is not None and _source_round(rec) >= cur_round:
+                continue  # this round's own (re-)runs are not a baseline
             if best is None or _source_round(rec) > _source_round(best):
                 best = rec
         except Exception:

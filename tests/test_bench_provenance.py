@@ -127,6 +127,26 @@ def test_emit_degraded_attaches_cpu_trend(repo, monkeypatch, capsys):
     assert tr["delta_pct"] == round(100 * (5.5 - 5.9) / 5.9, 1)
 
 
+def test_cpu_trend_excludes_current_round_rerun(repo, monkeypatch, capsys):
+    """ADVICE r5: a re-run within a round must not pick ITS OWN round's
+    earlier record as the trend baseline (delta ~0 would mask a real
+    regression) — the previous round's record is the baseline."""
+    _write(str(repo / "BENCH_r04.json"),
+           {"value": 6.0, "device": "cpu (DEGRADED: canary failed)"})
+    _write(str(repo / "BENCH_r05.json"),   # this round's earlier re-run
+           {"value": 5.5, "device": "cpu (DEGRADED: canary failed)"})
+    monkeypatch.setenv("TPULAB_BENCH_ROUND", "5")
+    monkeypatch.delenv("TPULAB_BENCH_NO_CARRY", raising=False)
+    monkeypatch.setattr(bench, "_state", {
+        "done": True, "phase": "emit", "device": "cpu", "degraded": True,
+        "details": {"b1_inf_s": 5.5}})
+    bench._emit_line()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    tr = line["cpu_trend"]
+    assert tr["prev_round"] == 4 and tr["prev_cpu_value"] == 6.0
+    assert tr["delta_pct"] == round(100 * (5.5 - 6.0) / 6.0, 1)
+
+
 def test_emit_on_device_saves_last_good(repo, monkeypatch, capsys):
     monkeypatch.setenv("TPULAB_BENCH_ROUND", "4")
     monkeypatch.setattr(bench, "_state", {

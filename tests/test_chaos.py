@@ -1,0 +1,509 @@
+"""Chaos suite: deterministic fault injection over live loopback servers
+(ISSUE 1 tentpole).  Every scenario is driven by a seeded
+:class:`tpulab.chaos.FaultSchedule` — no sleeps-as-synchronization, no
+real-time races decide outcomes: rules fire at exact occurrence counts,
+so a failure here reproduces under the same seed.
+
+Covers the degradation contracts the serving stack promises:
+- transient engine faults fail the in-flight work and RECOVER (pool
+  reset; the next request succeeds),
+- expired deadlines cancel before the next token step and FREE resources
+  (batcher lanes + KV pages, dense session slots),
+- mid-stream faults (server-side and client-transport) fail over
+  exactly-once through the replica sets,
+- the circuit breaker ejects a dead replica and the background probe
+  restores it after recovery,
+- drain/shutdown completes in-flight streams while refusing new work.
+
+Run it alone with ``pytest -m chaos``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tpulab
+from tpulab import chaos
+from tpulab.core.deadline import DeadlineExceeded
+from tpulab.models.mnist import make_mnist
+
+pytestmark = pytest.mark.chaos
+
+X = np.zeros((1, 28, 28, 1), np.float32)
+
+
+# ----------------------------------------------------------- helpers -------
+def _serve_mnist(max_exec=1, max_buffers=4, port=0):
+    mgr = tpulab.InferenceManager(max_exec_concurrency=max_exec,
+                                  max_buffers=max_buffers)
+    mgr.register_model("mnist", make_mnist(max_batch_size=2))
+    mgr.update_resources()
+    mgr.serve(port=port)
+    return mgr
+
+
+def _lm_params():
+    from tpulab.models.transformer import init_transformer_params
+    return init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                   n_layers=2, d_ff=64)  # seed=0 default
+
+
+def _serve_lm():
+    import jax.numpy as jnp
+
+    from tpulab.engine.generation import GenerationEngine
+    eng = GenerationEngine(_lm_params(), n_heads=2, n_layers=2, max_len=64,
+                           max_sessions=2, compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": eng})
+    return mgr, eng
+
+
+@pytest.fixture(scope="module")
+def lm_pair():
+    """Two identical-weights LM replicas, decode paths pre-warmed so
+    chaos windows never race jit compilation."""
+    from tpulab.rpc.infer_service import GenerateStreamClient
+    mgr_a, eng = _serve_lm()
+    mgr_b, _ = _serve_lm()
+    for m in (mgr_a, mgr_b):  # warm each replica's decode compile
+        from tpulab.rpc.infer_service import RemoteInferenceManager
+        remote = RemoteInferenceManager(f"127.0.0.1:{m.server.bound_port}")
+        try:
+            list(GenerateStreamClient(remote, "lm").generate(
+                np.arange(3, dtype=np.int32), 2))
+        finally:
+            remote.close()
+    yield mgr_a, mgr_b, eng
+    for m in (mgr_a, mgr_b):
+        try:
+            m.shutdown()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------- schedule semantics ---
+def test_schedule_grammar_windows_and_seeded_determinism():
+    s = chaos.FaultSchedule.parse("p=error@2+1;q=delay:0.0", seed=5)
+    with chaos.inject(s):
+        assert chaos.trip("p") is None          # occurrence 0
+        assert chaos.trip("p") is None          # occurrence 1
+        with pytest.raises(chaos.ChaosError):
+            chaos.trip("p")                     # @2: fires
+        assert chaos.trip("p") is None          # +1: exhausted
+        assert chaos.trip("q") is None          # delay returns None
+    assert chaos.trip("p") is None              # disarmed: free
+    assert s.occurrences("p") == 4 and s.fired("p") == 1
+
+    def draws(seed):
+        sched = chaos.FaultSchedule.parse("r=error%0.5", seed=seed)
+        out = []
+        with chaos.inject(sched):
+            for _ in range(32):
+                try:
+                    chaos.trip("r")
+                    out.append(0)
+                except chaos.ChaosError:
+                    out.append(1)
+        return out
+
+    assert draws(11) == draws(11)               # same seed, same pattern
+    assert 0 < sum(draws(11)) < 32              # and it actually mixes
+
+    # kill parses (exercised only in subprocess tests); drop round-trips
+    rule = chaos.FaultRule.parse("x=kill@3")
+    assert rule.action == "kill" and rule.after == 3
+    with chaos.inject("y=drop+1"):
+        assert chaos.trip("y") == "drop"
+        assert chaos.trip("y") is None
+
+
+def test_env_var_arms_subprocess():
+    import subprocess
+    import sys
+    code = ("import tpulab.chaos as c; s = c.armed(); "
+            "assert s is not None and s.seed == 7; "
+            "assert s.rules[0].point == 'engine.step'; print('armed')")
+    import os
+    env = dict(os.environ, TPULAB_CHAOS="engine.step=delay:0.01",
+               TPULAB_CHAOS_SEED="7",
+               PYTHONPATH=__file__.rsplit("/tests/", 1)[0])
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0 and "armed" in res.stdout, res.stderr
+
+
+# ------------------------------------------------- batcher: engine faults --
+@pytest.fixture(scope="module")
+def batcher():
+    import jax.numpy as jnp
+
+    from tpulab.engine.paged import ContinuousBatcher
+    cb = ContinuousBatcher(_lm_params(), n_heads=2, n_layers=2, lanes=2,
+                           max_len=64, page_size=8,
+                           compute_dtype=jnp.float32)
+    # warm prefill+decode compiles outside any chaos window
+    assert len(cb.submit(np.arange(4, dtype=np.int32), 3)
+               .result(timeout=120)) == 3
+    yield cb
+    cb.shutdown()
+
+
+def test_transient_engine_fault_fails_inflight_and_recovers(batcher):
+    """An injected decode-tick fault rides the scheduler's recovery path:
+    the in-flight request fails with the fault, the pool resets, and the
+    very next request is served normally."""
+    free0 = batcher.pool.free_pages
+    with chaos.inject("engine.step=error@1+1"):
+        fut = batcher.submit(np.arange(4, dtype=np.int32), 8)
+        with pytest.raises(chaos.ChaosError):
+            fut.result(timeout=60)
+    toks = batcher.submit(np.arange(4, dtype=np.int32), 5).result(timeout=60)
+    assert len(toks) == 5
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and batcher.pool.free_pages != free0:
+        time.sleep(0.01)
+    assert batcher.pool.free_pages == free0  # nothing leaked
+
+
+def test_deadline_storm_frees_lanes_and_pages(batcher):
+    """Six requests with budgets far below their decode time, on slowed
+    steps: every future fails DeadlineExceeded, lanes and KV pages free
+    within a step of expiry, and the batcher keeps serving."""
+    free0 = batcher.pool.free_pages
+    prompt = np.arange(4, dtype=np.int32)
+    with chaos.inject("engine.step=delay:0.05"):
+        futs = [batcher.submit(prompt, 50, deadline=0.2) for _ in range(6)]
+        for f in futs:
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=60)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and (
+            batcher.active_lanes or batcher.queued_requests
+            or batcher.pool.free_pages != free0):
+        time.sleep(0.01)
+    assert batcher.active_lanes == 0 and batcher.queued_requests == 0
+    assert batcher.pool.free_pages == free0   # every page returned
+    toks = batcher.submit(prompt, 4).result(timeout=60)
+    assert len(toks) == 4                     # lanes genuinely usable
+
+
+# ------------------------------------------------------ RPC deadlines ------
+def test_rpc_deadline_dense_reports_status_and_frees_session(lm_pair):
+    """A deadline riding GenerateRequest.deadline_ms cancels the dense
+    stream before its next token step: the client sees DeadlineExceeded
+    (from the server's DEADLINE_EXCEEDED status) and the session slot
+    returns to the pool."""
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+    mgr_a, _, eng = lm_pair
+    remote = RemoteInferenceManager(f"127.0.0.1:{mgr_a.server.bound_port}")
+    try:
+        with chaos.inject("engine.step=delay:0.05"):
+            with pytest.raises(DeadlineExceeded):
+                list(GenerateStreamClient(remote, "lm").generate(
+                    np.arange(4, dtype=np.int32), 50, deadline_s=0.3))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and eng.available_sessions < 2:
+            time.sleep(0.01)
+        assert eng.available_sessions == 2    # lease freed at expiry
+        # and the replica still serves within budget afterwards
+        toks = list(GenerateStreamClient(remote, "lm").generate(
+            np.arange(4, dtype=np.int32), 3, deadline_s=60.0))
+        assert len(toks) == 3
+    finally:
+        remote.close()
+
+
+def test_rpc_deadline_paged_frees_lanes():
+    """Same contract through a continuous-batching engine: expiry fails
+    the stream with DEADLINE_EXCEEDED and the lane/pages free."""
+    import jax.numpy as jnp
+
+    from tpulab.engine.paged import ContinuousBatcher
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+    cb = ContinuousBatcher(_lm_params(), n_heads=2, n_layers=2, lanes=2,
+                           max_len=64, page_size=8,
+                           compute_dtype=jnp.float32)
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.register_model("mnist", make_mnist(max_batch_size=1))
+    mgr.update_resources()
+    mgr.serve(port=0, generation_engines={"lm": cb})
+    remote = RemoteInferenceManager(f"127.0.0.1:{mgr.server.bound_port}")
+    try:
+        client = GenerateStreamClient(remote, "lm")
+        assert len(list(client.generate(np.arange(3, dtype=np.int32),
+                                        2))) == 2  # warm compiles
+        free0 = cb.pool.free_pages
+        with chaos.inject("engine.step=delay:0.05"):
+            with pytest.raises(DeadlineExceeded):
+                list(client.generate(np.arange(4, dtype=np.int32), 50,
+                                     deadline_s=0.25))
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and (
+                cb.active_lanes or cb.pool.free_pages != free0):
+            time.sleep(0.01)
+        assert cb.active_lanes == 0 and cb.pool.free_pages == free0
+    finally:
+        remote.close()
+        mgr.shutdown()
+
+
+# --------------------------------------------- mid-stream failover ---------
+def test_server_fault_mid_stream_fails_over_exactly_once(lm_pair):
+    """A transient server fault mid-generation (INTERNAL, retryable):
+    the replica set replays on the other replica, skips the delivered
+    prefix, and the consumer sees the exact greedy sequence once."""
+    from tpulab.rpc.replica import GenerationReplicaSet
+    mgr_a, mgr_b, eng = lm_pair
+    addrs = [f"127.0.0.1:{m.server.bound_port}" for m in (mgr_a, mgr_b)]
+    grs = GenerationReplicaSet(addrs, "lm")
+    try:
+        prompt = np.arange(5, dtype=np.int32)
+        steps = 12
+        expected = list(eng.generate(prompt[None, :], steps)[0])
+        with chaos.inject("rpc.server.generate_token=error@3+1"):
+            got = list(grs.generate(prompt, steps))
+        assert got == expected, (got, expected)
+        assert sum(grs.served) == 1           # exactly one completion
+    finally:
+        grs.close()
+
+
+def test_client_transport_fault_mid_stream_fails_over(lm_pair):
+    """The stream dying at the TRANSPORT (read loop) mid-flight — what a
+    replica crash looks like from the client — replays exactly-once."""
+    from tpulab.rpc.replica import GenerationReplicaSet
+    mgr_a, mgr_b, eng = lm_pair
+    addrs = [f"127.0.0.1:{m.server.bound_port}" for m in (mgr_a, mgr_b)]
+    grs = GenerationReplicaSet(addrs, "lm")
+    try:
+        prompt = np.arange(4, dtype=np.int32)
+        steps = 10
+        expected = list(eng.generate(prompt[None, :], steps)[0])
+        with chaos.inject("rpc.client.stream_recv=error@2+1"):
+            got = list(grs.generate(prompt, steps))
+        assert got == expected, (got, expected)
+    finally:
+        grs.close()
+
+
+# ------------------------------------------------- circuit breaker ---------
+def test_circuit_breaker_ejects_and_background_probe_restores():
+    """A dead replica is ejected after `breaker_threshold` consecutive
+    failures (state open), steady-state traffic stops touching it, and
+    the background health probe restores it (state closed) once it is
+    back — no health() call from the application required."""
+    from tests.conftest import free_port
+    from tpulab.rpc.replica import ReplicaSet
+    port_b = free_port()
+    mgr_a = _serve_mnist()
+    mgr_b = _serve_mnist(port=port_b)
+    rs = None
+    try:
+        addrs = [f"127.0.0.1:{mgr_a.server.bound_port}",
+                 f"127.0.0.1:{port_b}"]
+        rs = ReplicaSet(addrs, "mnist", breaker_threshold=2,
+                        probe_backoff_s=0.05, probe_backoff_cap_s=0.5)
+        for _ in range(4):  # warm both runners
+            rs.infer(Input3=X).result(timeout=60)
+        assert set(rs.breaker_states().values()) == {"closed"}
+        mgr_b.shutdown()
+        for _ in range(10):  # failures accumulate until ejection
+            rs.infer(Input3=X).result(timeout=60)
+            if rs.ejections:
+                break
+        assert rs.ejections == 1
+        assert rs.breaker_states()[addrs[1]] in ("open", "probing")
+        # ejected: traffic routes to the survivor WITHOUT failover churn
+        served0, served1 = rs.served[0], rs.served[1]
+        streak1 = rs._fail_streak[1]
+        for _ in range(6):
+            rs.infer(Input3=X).result(timeout=60)
+        assert rs.served[0] - served0 == 6
+        assert rs.served[1] == served1
+        # the dead replica was never even attempted while open
+        assert rs._fail_streak[1] == streak1
+        # replica returns on the same port; the BACKGROUND probe restores
+        mgr_b = _serve_mnist(port=port_b)
+        deadline = time.monotonic() + 30  # grpc channel reconnect backoff
+        while (time.monotonic() < deadline
+               and rs.breaker_states()[addrs[1]] != "closed"):
+            time.sleep(0.05)
+        assert rs.breaker_states()[addrs[1]] == "closed"
+        # and traffic actually reaches it again
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and rs.served[1] == served1:
+            rs.infer(Input3=X).result(timeout=60)
+        assert rs.served[1] > served1
+    finally:
+        if rs is not None:
+            rs.close()
+        mgr_a.shutdown()
+        try:
+            mgr_b.shutdown()
+        except Exception:
+            pass
+
+
+def test_unary_deadline_with_blackholed_calls():
+    """Per-attempt budgets derived from the end-to-end deadline: a
+    black-holed first attempt (dropped RPC — no error, no response) times
+    out on its slice of the budget and fails over within the deadline;
+    with EVERY call dropped the overall future fails by deadline instead
+    of hanging."""
+    from tpulab.rpc.replica import ReplicaSet
+    mgr_a, mgr_b = _serve_mnist(), _serve_mnist()
+    rs = None
+    try:
+        addrs = [f"127.0.0.1:{m.server.bound_port}"
+                 for m in (mgr_a, mgr_b)]
+        rs = ReplicaSet(addrs, "mnist")
+        rs.infer(Input3=X).result(timeout=60)  # warm runners (Status RPC)
+        with chaos.inject("rpc.client.unary=drop+1"):
+            out = rs.infer(deadline_s=8.0, Input3=X).result(timeout=30)
+        assert out["Plus214_Output_0"].shape == (1, 10)
+        with chaos.inject("rpc.client.unary=drop"):
+            with pytest.raises(TimeoutError):  # DeadlineExceeded is one
+                rs.infer(deadline_s=0.8, Input3=X).result(timeout=30)
+    finally:
+        if rs is not None:
+            rs.close()
+        mgr_a.shutdown()
+        mgr_b.shutdown()
+
+
+# ------------------------------------------------- drain under load --------
+def test_drain_under_load_completes_streams_and_refuses_new():
+    """Rolling-restart under chaos-paced load: drain flips readiness while
+    serving everything in flight AND late arrivals; Server.shutdown's
+    grace then completes the in-flight stream but refuses new RPCs."""
+    import grpc
+
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+    mgr, _eng = _serve_lm()
+    remote = RemoteInferenceManager(f"127.0.0.1:{mgr.server.bound_port}")
+    try:
+        client = GenerateStreamClient(remote, "lm")
+        list(client.generate(np.arange(3, dtype=np.int32), 2))  # warm
+        runner = remote.infer_runner("mnist")
+        x1 = np.zeros((1, 28, 28, 1), np.float32)
+        runner.infer(Input3=x1).result(timeout=60)              # warm
+
+        with chaos.inject("engine.step=delay:0.03"):
+            # ---- drain phase: in-flight + late arrivals still served
+            toks1 = []
+            t1 = threading.Thread(target=lambda: toks1.extend(
+                client.generate(np.arange(4, dtype=np.int32), 20)))
+            t1.start()
+            time.sleep(0.15)                  # stream is mid-flight
+            drained = [None]
+            td = threading.Thread(target=lambda: drained.__setitem__(
+                0, mgr.drain(timeout=60.0, settle_s=0.2)))
+            td.start()
+            time.sleep(0.05)
+            h = remote.health()
+            assert h.live and not h.ready     # rotated out, still alive
+            # late arrival during drain is SERVED, never refused
+            out = runner.infer(Input3=x1).result(timeout=60)
+            assert out["Plus214_Output_0"].shape == (1, 10)
+            td.join(timeout=120)
+            t1.join(timeout=120)
+            assert drained[0] is True
+            assert len(toks1) == 20           # stream finished intact
+
+            # ---- shutdown grace: in-flight completes, new work refused
+            it = client.generate(np.arange(4, dtype=np.int32), 20)
+            first = next(it)                  # stream provably in flight
+            ts = threading.Thread(
+                target=lambda: mgr.server.shutdown(grace_s=30.0))
+            ts.start()
+            # once stop engages, a new RPC is either rejected outright
+            # (UNAVAILABLE) or accepted-but-never-served until the grace
+            # cancels it — both are "refused" for this contract, so each
+            # probe carries its own short gRPC deadline
+            import concurrent.futures as _f
+            refused = False
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not refused:
+                try:
+                    runner.infer(timeout=2.0, Input3=x1).result(timeout=5)
+                    time.sleep(0.02)
+                except (grpc.RpcError, RuntimeError,
+                        _f.TimeoutError, TimeoutError):
+                    refused = True            # server stopped taking work
+            assert refused
+            toks2 = [first] + list(it)
+            assert len(toks2) == 20           # grace let it finish
+            ts.join(timeout=120)
+            assert not ts.is_alive()
+    finally:
+        remote.close()
+        try:
+            mgr.shutdown()
+        except Exception:
+            pass
+
+
+# ------------------------------------------ process death (subprocess) -----
+@pytest.mark.slow
+def test_replica_process_death_injected_via_env():
+    """The `kill` action: a SUBPROCESS replica armed through TPULAB_CHAOS
+    os._exit()s mid-stream (TCP reset, no goodbye); the replica set fails
+    over to the in-process survivor exactly-once.  Marked slow (spawns a
+    full jax process); the in-process suite above covers tier-1."""
+    import os
+    import select
+    import subprocess
+    import sys
+
+    from tpulab.rpc.replica import GenerationReplicaSet
+
+    repo = __file__.rsplit("/tests/", 1)[0]
+    env = dict(os.environ, PYTHONPATH=repo,
+               TPULAB_CHAOS="rpc.server.generate_token=kill@2")
+    proc = subprocess.Popen(
+        [sys.executable, f"{repo}/tests/helpers_lm_server.py",
+         "--delay-ms", "30"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    mgr = grs = None
+    try:
+        port = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and port is None:
+            ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if not ready:
+                if proc.poll() is not None:
+                    break
+                continue
+            line = proc.stdout.readline()
+            if line.startswith("PORT "):
+                port = int(line.split()[1])
+            elif line == "":
+                break
+        assert port is not None, proc.stderr.read()[-1500:]
+        mgr, eng = _serve_lm()
+        prompt = np.arange(5, dtype=np.int32)
+        steps = 10
+        expected = list(eng.generate(prompt[None, :], steps)[0])
+        grs = GenerationReplicaSet(
+            [f"127.0.0.1:{port}",
+             f"127.0.0.1:{mgr.server.bound_port}"], "lm")
+        got = list(grs.generate(prompt, steps))
+        assert got == expected, (got, expected)
+        proc.wait(timeout=60)
+        assert proc.returncode == chaos.KILL_EXIT_CODE  # died by injection
+    finally:
+        if grs is not None:
+            grs.close()
+        if mgr is not None:
+            mgr.shutdown()
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)

@@ -342,6 +342,18 @@ def test_parser_rejects_malformed_bytes():
         assert isinstance(e, (ValueError, IndexError)), e
 
 
+def test_unsupported_dtype_reports_code_and_tensor():
+    """ADVICE r5: bfloat16/float8 zoo tensors must raise a diagnosable
+    NotImplementedError naming the ONNX dtype code and tensor, not a bare
+    KeyError from the _DTYPES lookup."""
+    from tpulab.models.onnx_import import _decode_tensor
+    buf = (_vint(1, 2) + _vint(2, 16)            # dims=[2], BFLOAT16
+           + _ld(8, b"w_bf16") + _ld(9, b"\x00" * 4))
+    with pytest.raises(NotImplementedError,
+                       match=r"code 16 \[BFLOAT16\] \(tensor 'w_bf16'\)"):
+        _decode_tensor(buf)
+
+
 def test_external_data_tensors(tmp_path):
     """data_location=EXTERNAL initializers (how >2 GB zoo models ship
     weights) load from the sidecar file at offset/length; escaping

@@ -603,6 +603,39 @@ def test_speculative_equals_target_greedy():
     assert g_mix.rounds >= g_self.rounds  # worse draft -> more rounds
 
 
+def test_engines_reject_out_of_range_ids_at_library_boundary():
+    """ADVICE r5: XLA gather CLAMPS out-of-bounds token ids (silent
+    garbage).  ContinuousBatcher.submit always validated; the dense and
+    speculative engines must reject DIRECT library callers too, not just
+    the Generate RPC's shared check."""
+    import jax.numpy as jnp
+    import pytest
+
+    from tpulab.engine.generation import GenerationEngine
+    from tpulab.engine.speculative import SpeculativeGenerator
+    from tpulab.models.transformer import init_transformer_params
+
+    params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                     n_layers=1, d_ff=48)
+    eng = GenerationEngine(params, n_heads=2, n_layers=1, max_len=32,
+                           max_sessions=1, compute_dtype=jnp.float32)
+    bad = np.array([3, 64], np.int32)          # 64 == vocab: one past
+    with pytest.raises(ValueError, match=r"outside \[0, 64\)"):
+        eng.generate(bad[None, :], 2)
+    with eng.start_session() as sess:
+        with pytest.raises(ValueError, match=r"outside \[0, 64\)"):
+            sess.prefill(np.array([-1, 3], np.int32))
+        sess.prefill(np.array([1, 2], np.int32))   # session still usable
+        with pytest.raises(ValueError, match=r"outside \[0, 64\)"):
+            sess.step(64)                          # teacher-forced id too
+        assert 0 <= sess.step() < 64
+
+    spec = SpeculativeGenerator(params, params, n_heads=2, n_layers=1,
+                                k=2, max_len=32, compute_dtype=jnp.float32)
+    with pytest.raises(ValueError, match=r"outside \[0, 64\)"):
+        spec.stream(np.array([0, 64], np.int32), 2)  # EAGER: at call time
+
+
 def test_speculative_benchmark_row():
     """The bench's speculative row (VERDICT r4 #7): early-exit draft gets
     nonzero acceptance, exactness holds, and the record carries every

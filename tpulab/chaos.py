@@ -1,0 +1,253 @@
+"""Deterministic fault injection (the resilience analog of the reference's
+nvrpc test doubles, extended to a serving stack that must *prove* graceful
+degradation: SURVEY §2.4 health/drain machinery, PAPERS.md adaptive-
+orchestration resilience argument).
+
+TPU-native serving fails in ways trtlab's single-host GPU story never
+exercised — preempted TPU VMs, multihost meshes losing a worker, streaming
+LLM requests holding lanes for seconds — so the failover/deadline/breaker
+paths need to be drivable *on demand and reproducibly*.  This module plants
+named **injection points** on the hot paths; each is a single
+``chaos.trip("<point>")`` call that costs ONE module-global ``is None``
+branch when disarmed (no threads, no locks, no allocation — production pays
+nothing).
+
+Armed, a :class:`FaultSchedule` maps points to rules:
+
+    with chaos.inject(FaultSchedule.parse(
+            "engine.step=delay:0.02;rpc.client.unary=error@2", seed=7)):
+        ...
+
+or via environment (picked up at import, so subprocess replicas arm
+themselves)::
+
+    TPULAB_CHAOS="rpc.server.generate_token=kill@3" python server.py
+
+Rule grammar (``;``-separated)::
+
+    <point>=<action>[:<value>][@<after>][+<times>][%<prob>]
+
+    action  error  raise ChaosError at the point (transient fault)
+            delay  sleep <value> seconds (slow step / slow link)
+            drop   black-hole the operation (only points that declare
+                   drop support honor it; others treat it as error)
+            kill   os._exit(86) — replica process death (use only on
+                   subprocess replicas!)
+    @N      skip the first N occurrences of the point (default 0)
+    +K      fire at most K times (default unlimited)
+    %P      fire with probability P per eligible occurrence, drawn from
+            the schedule's seeded RNG (default 1.0 — deterministic)
+
+Occurrence counting is per-point and process-global; with the default
+``%1.0`` a schedule is fully deterministic, and with ``%P`` the seeded RNG
+makes the *sequence of draws* reproducible.
+
+Injection points currently planted (see docs/ROBUSTNESS.md):
+
+    rpc.client.unary          ClientUnary.start, before the call (drop-capable)
+    rpc.client.stream_recv    ClientStreaming read loop, per response
+    rpc.server.generate_token GenerateContext dense loop, per token (kill site)
+    engine.step               ContinuousBatcher tick + GenerationSession.step
+    engine.prefill            ContinuousBatcher fused prefill
+    device.transfer           Bindings.copy_to_device (host->device staging)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger("tpulab.chaos")
+
+#: module-global armed schedule; ``None`` (the default) is the ONE branch
+#: every injection point pays in production
+_ARMED: Optional["FaultSchedule"] = None
+
+_ACTIONS = ("error", "delay", "drop", "kill")
+
+#: exit code for the ``kill`` action — distinguishable from a real crash
+KILL_EXIT_CODE = 86
+
+
+class ChaosError(RuntimeError):
+    """The injected transient fault (``error`` action).  A RuntimeError on
+    purpose: callers must survive it through their *generic* failure
+    handling, not a chaos-aware special case."""
+
+
+class FaultRule:
+    """One point's behavior: action + occurrence window + probability."""
+
+    __slots__ = ("point", "action", "value", "after", "times", "prob")
+
+    def __init__(self, point: str, action: str, value: float = 0.0,
+                 after: int = 0, times: Optional[int] = None,
+                 prob: float = 1.0):
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r} "
+                             f"(want one of {_ACTIONS})")
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError("prob must be in [0, 1]")
+        self.point = point
+        self.action = action
+        self.value = float(value)
+        self.after = int(after)
+        self.times = times
+        self.prob = float(prob)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultRule":
+        """``point=action[:value][@after][+times][%prob]`` (module grammar)."""
+        point, _, rhs = spec.partition("=")
+        if not rhs:
+            raise ValueError(f"chaos rule {spec!r}: want point=action[...]")
+        kw = dict(value=0.0, after=0, times=None, prob=1.0)
+        # peel modifiers right-to-left; each marker appears at most once
+        for marker, key, conv in (("%", "prob", float), ("+", "times", int),
+                                  ("@", "after", int)):
+            if marker in rhs:
+                rhs, _, raw = rhs.rpartition(marker)
+                kw[key] = conv(raw)
+        action, _, val = rhs.partition(":")
+        if val:
+            kw["value"] = float(val)
+        return cls(point.strip(), action.strip(), **kw)
+
+    def __repr__(self) -> str:
+        return (f"FaultRule({self.point}={self.action}:{self.value}"
+                f"@{self.after}+{self.times}%{self.prob})")
+
+
+class FaultSchedule:
+    """Seeded, deterministic rule set driving the injection points.
+
+    Thread-safe: occurrence counters and the RNG sit behind one lock (the
+    armed path is the *test* path — production never reaches it)."""
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0):
+        self.rules = list(rules)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._seen: Dict[str, int] = {}    # point -> occurrences observed
+        self._fired: Dict[str, int] = {}   # point -> rule activations
+        self._per_rule_fired = [0] * len(self.rules)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "FaultSchedule":
+        rules = [FaultRule.parse(part)
+                 for part in spec.split(";") if part.strip()]
+        return cls(rules, seed=seed)
+
+    # -- observability (test assertions) ------------------------------------
+    def occurrences(self, point: str) -> int:
+        """How many times ``point`` was reached (armed window only)."""
+        with self._lock:
+            return self._seen.get(point, 0)
+
+    def fired(self, point: str) -> int:
+        """How many times a rule activated at ``point``."""
+        with self._lock:
+            return self._fired.get(point, 0)
+
+    # -- the injection-point entry ------------------------------------------
+    def fire(self, point: str) -> Optional[str]:
+        """Apply the first matching eligible rule.  Returns ``"drop"`` when
+        a drop rule fires (the call site black-holes the operation), raises
+        :class:`ChaosError` for ``error``, sleeps for ``delay``, exits the
+        process for ``kill``; returns None when nothing fires."""
+        action = None
+        value = 0.0
+        with self._lock:
+            n = self._seen.get(point, 0)
+            self._seen[point] = n + 1
+            for i, rule in enumerate(self.rules):
+                if rule.point != point or n < rule.after:
+                    continue
+                if (rule.times is not None
+                        and self._per_rule_fired[i] >= rule.times):
+                    continue
+                if rule.prob < 1.0 and self._rng.random() >= rule.prob:
+                    continue
+                self._per_rule_fired[i] += 1
+                self._fired[point] = self._fired.get(point, 0) + 1
+                action, value = rule.action, rule.value
+                break
+        if action is None:
+            return None
+        log.debug("chaos: %s at %s (value=%s)", action, point, value)
+        if action == "delay":
+            if value > 0:
+                time.sleep(value)
+            return None
+        if action == "error":
+            raise ChaosError(f"injected fault at {point}")
+        if action == "kill":
+            # a replica process death, not an exception: no finally blocks,
+            # no grpc goodbye — the peer sees a TCP reset
+            os._exit(KILL_EXIT_CODE)
+        return "drop"
+
+
+def trip(point: str) -> Optional[str]:
+    """THE injection point.  Disarmed cost: one global load + one branch.
+    Returns ``"drop"`` when an armed drop rule fires (only call sites that
+    can black-hole an operation need to look at the return value)."""
+    s = _ARMED
+    if s is None:
+        return None
+    return s.fire(point)
+
+
+def arm(schedule: Optional[FaultSchedule]) -> None:
+    """Install (or with ``None`` remove) the process-wide schedule."""
+    global _ARMED
+    _ARMED = schedule
+
+
+def armed() -> Optional[FaultSchedule]:
+    return _ARMED
+
+
+class inject:
+    """Context manager arming a schedule for a ``with`` block::
+
+        sched = FaultSchedule.parse("engine.step=error+1", seed=3)
+        with chaos.inject(sched):
+            ...
+
+    Accepts a :class:`FaultSchedule` or a spec string.  Re-entrant use
+    restores the previously armed schedule on exit (nesting composes the
+    way tests expect: innermost wins)."""
+
+    def __init__(self, schedule, seed: int = 0):
+        if isinstance(schedule, str):
+            schedule = FaultSchedule.parse(schedule, seed=seed)
+        self.schedule = schedule
+        self._prev: Optional[FaultSchedule] = None
+
+    def __enter__(self) -> FaultSchedule:
+        self._prev = _ARMED
+        arm(self.schedule)
+        return self.schedule
+
+    def __exit__(self, *exc) -> None:
+        arm(self._prev)
+
+
+def _arm_from_env() -> None:
+    """``TPULAB_CHAOS`` arms at import so subprocess replicas inherit the
+    schedule through their environment (``TPULAB_CHAOS_SEED`` seeds it)."""
+    spec = os.environ.get("TPULAB_CHAOS", "").strip()
+    if not spec:
+        return
+    seed = int(os.environ.get("TPULAB_CHAOS_SEED", "0"))
+    arm(FaultSchedule.parse(spec, seed=seed))
+    log.warning("chaos armed from TPULAB_CHAOS=%r (seed=%d)", spec, seed)
+
+
+_arm_from_env()

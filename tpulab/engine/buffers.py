@@ -150,6 +150,11 @@ class Bindings:
         manager's coalesce_h2d flag the bindings ride the TransferEngine's
         batched put (one device_put per cycle across concurrent requests);
         otherwise each binding dispatches its own async put."""
+        from tpulab import chaos
+        # chaos: host->device transfer fault site (error = failed staging
+        # put, surfaces through the dispatch stage's failure path; delay =
+        # a congested link)
+        chaos.trip("device.transfer")
         engine = self._buffers.transfer_engine
         if engine is not None and self._buffers.coalesce_h2d:
             # blocks this dispatch thread until the collector's next cycle;

@@ -20,6 +20,8 @@ from typing import Any, Dict, Iterator, Optional
 
 import numpy as np
 
+from tpulab import chaos
+from tpulab.core.deadline import Deadline
 from tpulab.core.pool import Pool, PoolItem
 
 
@@ -68,10 +70,19 @@ class GenerationEngine:
         self._sessions: Pool = Pool(
             (self._init_cache() for _ in range(max_sessions)))
 
+    def _check_ids(self, tokens: np.ndarray) -> None:
+        """Host-boundary id validation: XLA gather CLAMPS out-of-bounds
+        ids (silent garbage tokens) — reject here instead, mirroring
+        ContinuousBatcher.submit so direct library callers are covered,
+        not just the Generate RPC (ADVICE r5)."""
+        if tokens.size and (tokens.min() < 0 or tokens.max() >= self.vocab):
+            raise ValueError(f"prompt token ids outside [0, {self.vocab})")
+
     # -- one-shot -----------------------------------------------------------
     def generate(self, prompt: np.ndarray, steps: int) -> np.ndarray:
         """Batch greedy generation (jitted prefill+decode scan)."""
         import jax.numpy as jnp
+        self._check_ids(np.asarray(prompt))
         return np.asarray(self._generate(jnp.asarray(prompt), steps))
 
     # -- streaming sessions --------------------------------------------------
@@ -109,6 +120,7 @@ class GenerationSession:
         import jax.numpy as jnp
         self._check_open()
         tokens = np.asarray(tokens, np.int32).reshape(-1)
+        self._engine._check_ids(tokens)
         if self._pos + len(tokens) > self._engine.max_len:
             raise ValueError(f"session length {self._pos + len(tokens)} "
                              f"exceeds max_len {self._engine.max_len}")
@@ -128,18 +140,28 @@ class GenerationSession:
             raise RuntimeError("prefill before generating")
         if token is None:
             token = int(np.asarray(self._last_logits).argmax(-1)[0])
+        elif not 0 <= int(token) < self._engine.vocab:
+            raise ValueError(f"token id {token} outside "
+                             f"[0, {self._engine.vocab})")
         if self._pos >= self._engine.max_len:
             raise ValueError(f"session exceeded max_len {self._engine.max_len}")
+        # chaos: per-decode-step fault site (transient failure / slow step)
+        chaos.trip("engine.step")
         self._last_logits, self._cache = self._engine._decode(
             self._engine.params, self._cache,
             jnp.asarray([token], jnp.int32), jnp.int32(self._pos))
         self._pos += 1
         return int(np.asarray(self._last_logits).argmax(-1)[0])
 
-    def stream(self, steps: int) -> Iterator[int]:
-        """Yield ``steps`` greedily generated tokens."""
+    def stream(self, steps: int,
+               deadline: Optional[Deadline] = None) -> Iterator[int]:
+        """Yield ``steps`` greedily generated tokens.  An expired
+        ``deadline`` raises DeadlineExceeded BEFORE the next decode step
+        (library-caller analog of the Generate RPC's per-token check)."""
         tok = None
         for _ in range(steps):
+            if deadline is not None:
+                deadline.check("generation")
             tok = self.step(tok)
             yield tok
 

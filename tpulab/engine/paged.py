@@ -23,11 +23,15 @@ from __future__ import annotations
 
 import functools
 import threading
+import time as _time
 from concurrent.futures import Future
 from functools import partial
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from tpulab import chaos
+from tpulab.core.deadline import Deadline, DeadlineExceeded
 
 
 class PagedKVPool:
@@ -632,12 +636,12 @@ class _PagedRequest:
     __slots__ = ("prompt", "steps", "future", "tokens_out", "pages",
                  "length", "pending_prompt", "on_token", "cancelled",
                  "sampling", "priority", "resumed", "admit_seq",
-                 "stop_tokens", "want_logprobs", "logprobs_out")
+                 "stop_tokens", "want_logprobs", "logprobs_out", "deadline")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
                  sampling: Optional[SamplingParams] = None,
                  priority: int = 0, stop_tokens=None,
-                 logprobs: bool = False):
+                 logprobs: bool = False, deadline: Optional[float] = None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.steps = steps
         self.future: Future = Future()
@@ -655,6 +659,10 @@ class _PagedRequest:
         self.stop_tokens = frozenset(int(t) for t in (stop_tokens or ()))
         self.want_logprobs = logprobs
         self.logprobs_out: List[float] = []
+        #: absolute monotonic expiry (None = unbounded); the scheduler's
+        #: per-iteration sweep cancels expired requests before their next
+        #: step, freeing the lane and pages
+        self.deadline = deadline
 
     def finished(self) -> bool:
         """steps exhausted, or the last emitted token is a stop token
@@ -812,7 +820,7 @@ class ContinuousBatcher:
     def submit(self, prompt, steps: int, on_token=None,
                sampling: Optional[SamplingParams] = None,
                priority: int = 0, stop_tokens=None,
-               logprobs: bool = False) -> Future:
+               logprobs: bool = False, deadline=None) -> Future:
         """``on_token(token, index)`` (optional) streams tokens as they
         decode — the hook the Generate RPC rides for paged serving.
         ``sampling`` selects the token policy (default greedy).
@@ -827,8 +835,16 @@ class ContinuousBatcher:
         and arms preemption: a queued request strictly outranking an active
         one evicts it — the victim's pages free immediately and it resumes
         later by re-prefilling prompt+generated (exact-token resume; with a
-        prefix cache the recompute mostly hits cached pages)."""
+        prefix cache the recompute mostly hits cached pages).
+        ``deadline`` (a :class:`~tpulab.core.deadline.Deadline` or a float
+        budget in seconds) bounds the request: the scheduler cancels it
+        before its next step once expired — lane and KV pages free within
+        one tick — and the future fails with DeadlineExceeded."""
         flat = np.asarray(prompt).reshape(-1)
+        if isinstance(deadline, Deadline):
+            deadline = deadline.expiry
+        elif deadline is not None:
+            deadline = _time.monotonic() + float(deadline)
         n_prompt = len(flat)
         if n_prompt == 0:
             raise ValueError("empty prompt")
@@ -842,7 +858,8 @@ class ContinuousBatcher:
             raise ValueError(f"prompt token ids outside [0, {self.vocab})")
         req = _PagedRequest(prompt, steps, on_token=on_token,
                             sampling=sampling, priority=priority,
-                            stop_tokens=stop_tokens, logprobs=logprobs)
+                            stop_tokens=stop_tokens, logprobs=logprobs,
+                            deadline=deadline)
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("ContinuousBatcher is shut down")
@@ -982,19 +999,43 @@ class ContinuousBatcher:
                     self._cv.wait()
                 if self._shutdown and not self._queue and not any(self._active):
                     return
-                # cancellation sweep: unconditional, so cancels land even
-                # when no lane can make progress (page-starved prefills)
+                # cancellation + deadline sweep: unconditional, so cancels
+                # and expiries land even when no lane can make progress
+                # (page-starved prefills).  Expired requests free their
+                # lane and pages HERE — before the next step runs
                 swept = []
+                expired = []
+                now = _time.monotonic()
                 for lane, req in enumerate(self._active):
-                    if req is not None and req.cancelled:
+                    if req is None:
+                        continue
+                    if req.cancelled:
                         self._release_lane_locked(lane, req)
                         swept.append(req)
+                    elif req.deadline is not None and now >= req.deadline:
+                        self._release_lane_locked(lane, req)
+                        expired.append(req)
+                if self._queue:  # queued requests expire in place
+                    still = []
+                    for req in self._queue:
+                        if (req.deadline is not None
+                                and now >= req.deadline):
+                            self._requests.pop(req.future, None)
+                            expired.append(req)
+                        else:
+                            still.append(req)
+                    self._queue[:] = still
                 self._admit_locked()
                 snapshot = list(self._active)
             for req in swept:
                 if not req.future.done():
                     req.future.cancel() or req.future.set_exception(
                         RuntimeError("generation cancelled"))
+            for req in expired:
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        "generation deadline exceeded "
+                        f"({len(req.tokens_out)}/{req.steps} tokens)"))
             try:
                 prefilled = False
                 for req in snapshot:
@@ -1067,6 +1108,10 @@ class ContinuousBatcher:
         tables = np.zeros((self.max_pages,), np.int32)
         tables[:len(req.pages)] = req.pages
         tables_j = jnp.asarray(tables)
+        # chaos: prefill fault site — an error here rides the scheduler's
+        # recovery path (fail actives + pool reset), a delay is a slow
+        # prefill under deadline pressure
+        chaos.trip("engine.prefill")
         if start == 0 and (self.prefill_chunk is None
                            or t <= self.prefill_chunk):
             t_pad = 1 << (t - 1).bit_length()  # pow2 bucket: small jit cache
@@ -1202,6 +1247,10 @@ class ContinuousBatcher:
 
         if not active.any():
             return False
+        # chaos: decode-tick fault site — an error fails the in-flight
+        # requests and resets the pool (the scheduler's recovery path); a
+        # delay makes every lane's step slow (deadline-storm scenarios)
+        chaos.trip("engine.step")
         # device-sampled lanes carry their temperature into the step (the
         # tick then fetches only (B,) token ids for them); host-sampled
         # (top_k) lanes keep temp 0 on device and pick from fetched logits

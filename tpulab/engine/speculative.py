@@ -115,6 +115,11 @@ class SpeculativeGenerator:
         # validate EAGERLY (at call time, not first iteration): direct
         # stream() callers get the ValueError before they start consuming
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size and (prompt.min() < 0 or prompt.max() >= self.vocab):
+            # XLA gather CLAMPS out-of-bounds ids — silent garbage; reject
+            # at the host boundary, mirroring ContinuousBatcher.submit
+            # (ADVICE r5: direct library callers, not just the RPC)
+            raise ValueError(f"prompt token ids outside [0, {self.vocab})")
         t_p = prompt.shape[0]
         if max(t_p + steps + self.k + 1,
                1 << (t_p - 1).bit_length()) > self.max_len:
@@ -305,12 +310,16 @@ class _SpeculativeSession:
             raise RuntimeError("session is closed")
         self._prompt = np.asarray(prompt, np.int32).reshape(-1)
 
-    def stream(self, steps: int):
+    def stream(self, steps: int, deadline=None):
         if self._closed:
             raise RuntimeError("session is closed")
         if self._prompt is None:
             raise RuntimeError("prefill() before stream()")
         inner = self._spec.stream(self._prompt, steps)
+        if deadline is not None:
+            # deadline checks ride the burst boundaries: verified tokens
+            # already computed still stream, the NEXT round is what stops
+            inner = self._deadlined(inner, deadline)
 
         def counted():
             # a session completes when its stream is EXHAUSTED, or when
@@ -334,6 +343,18 @@ class _SpeculativeSession:
             self._completed = True
 
         return counted()
+
+    @staticmethod
+    def _deadlined(inner, deadline):
+        # check BEFORE pulling the next round, so already-verified tokens
+        # still reach the consumer and no compute starts past expiry
+        while True:
+            deadline.check("generation")
+            try:
+                tok = next(inner)
+            except StopIteration:
+                return
+            yield tok
 
     def close(self) -> None:
         if not self._closed:

@@ -113,6 +113,27 @@ _DTYPES = {1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
            6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16,
            11: np.float64, 12: np.uint32, 13: np.uint64}
 
+# unsupported-but-known codes, named so the error diagnoses itself
+_DTYPE_NAMES = {0: "UNDEFINED", 8: "STRING", 14: "COMPLEX64",
+                15: "COMPLEX128", 16: "BFLOAT16", 17: "FLOAT8E4M3FN",
+                18: "FLOAT8E4M3FNUZ", 19: "FLOAT8E5M2",
+                20: "FLOAT8E5M2FNUZ", 21: "UINT4", 22: "INT4", 23: "FLOAT4E2M1"}
+
+
+def _np_dtype(code: int, tensor: str = "") -> np.dtype:
+    """numpy dtype for an ONNX TensorProto.DataType code; unsupported
+    codes raise a diagnosable error (naming code and tensor) instead of a
+    bare KeyError (ADVICE r5 — bfloat16/float8 zoo models hit this)."""
+    try:
+        return np.dtype(_DTYPES[code])
+    except KeyError:
+        known = _DTYPE_NAMES.get(code, "unknown")
+        where = f" (tensor {tensor!r})" if tensor else ""
+        raise NotImplementedError(
+            f"ONNX TensorProto dtype code {code} [{known}]{where} has no "
+            f"numpy equivalent in this importer; supported codes: "
+            f"{sorted(_DTYPES)}") from None
+
 
 def _decode_tensor(buf: bytes, base_dir: Optional[str] = None,
                    collect_external: Optional[list] = None
@@ -131,8 +152,8 @@ def _decode_tensor(buf: bytes, base_dir: Optional[str] = None,
     g = _group(buf)
     dims = _packed_varints(g.get(1, []))
     dt = _packed_varints(g.get(2, []))
-    dtype = np.dtype(_DTYPES[dt[0] if dt else 1])
     name = g[8][0][1].decode() if 8 in g else ""
+    dtype = _np_dtype(dt[0] if dt else 1, name)
     loc = _packed_varints(g.get(14, []))
     if loc and loc[0] == 1:  # EXTERNAL
         info = {}
@@ -759,7 +780,7 @@ def _dropout(conv, node, args):
 
 @_op("Cast")
 def _cast(conv, node, args):
-    return args[0].astype(np.dtype(_DTYPES[int(node.attrs["to"])]))
+    return args[0].astype(_np_dtype(int(node.attrs["to"]), node.name))
 
 
 @_op("Pad")

@@ -21,6 +21,7 @@ from typing import Any, Callable, List, Optional
 
 import grpc
 
+from tpulab import chaos
 from tpulab.core.async_compute import SharedPackagedTask
 
 _WRITES_DONE = object()
@@ -72,6 +73,21 @@ class ClientUnary:
         """Async call; returns a future of on_complete(response) (identity
         by default).  Mirrors async_compute-wrapped completions."""
         task = SharedPackagedTask(on_complete or (lambda resp: resp))
+        # chaos: delay/error the send, or black-hole it entirely — the
+        # future then resolves only via its own timeout, exactly what a
+        # dropped packet looks like to deadline/failover machinery (the
+        # timer exists only on this armed test path)
+        if chaos.trip("rpc.client.unary") == "drop":
+            fut = task.get_future()
+            if timeout is not None:
+                def _expire():
+                    if not fut.done():
+                        fut.set_exception(TimeoutError(
+                            f"chaos-dropped call timed out after {timeout}s"))
+                t = threading.Timer(timeout, _expire)
+                t.daemon = True
+                t.start()
+            return fut
         call = self._stub().future(request, timeout=timeout)
 
         def _done(c):
@@ -95,7 +111,11 @@ class ClientStreaming:
     def __init__(self, executor: ClientExecutor, method: str,
                  on_response: Callable[[Any], None],
                  request_serializer: Callable[[Any], bytes] = None,
-                 response_deserializer: Callable[[bytes], Any] = None):
+                 response_deserializer: Callable[[bytes], Any] = None,
+                 timeout: Optional[float] = None):
+        """``timeout`` sets the gRPC deadline for the WHOLE stream: the
+        transport-level backstop of the application deadline (the server
+        sees it via ``grpc-timeout`` metadata / ``time_remaining()``)."""
         self._on_response = on_response
         self._writes: "_queue.Queue" = _queue.Queue()
         self._done: Future = Future()
@@ -110,13 +130,16 @@ class ClientStreaming:
                     return
                 yield item
 
-        self._call = stub(request_iter())
+        self._call = stub(request_iter(), timeout=timeout)
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
     def _read_loop(self) -> None:
         try:
             for resp in self._call:
+                # chaos: a mid-stream transport fault — the error tears the
+                # stream down exactly like a dead replica would
+                chaos.trip("rpc.client.stream_recv")
                 self._on_response(resp)
             self._done.set_result(None)
         except BaseException as e:  # noqa: BLE001

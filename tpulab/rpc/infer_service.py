@@ -15,6 +15,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from tpulab import chaos
+from tpulab.core.deadline import Deadline, DeadlineExceeded
 from tpulab.core.resources import Resources
 from tpulab.rpc.client import ClientExecutor, ClientStreaming, ClientUnary
 from tpulab.rpc.context import Context, StreamingContext
@@ -446,6 +448,19 @@ class GenerateContext(StreamingContext):
         finally:
             res.request_finished()
 
+    def _deadline_of(self, request: pb.GenerateRequest) -> Optional[Deadline]:
+        """The request's end-to-end budget: explicit ``deadline_ms``
+        metadata first, else the gRPC transport deadline (``grpc-timeout``
+        header) when one rode in.  None = unbounded."""
+        if request.deadline_ms:
+            return Deadline.after(request.deadline_ms / 1e3)
+        g = self.grpc_context
+        if g is not None and hasattr(g, "time_remaining"):
+            rem = g.time_remaining()
+            if rem is not None:
+                return Deadline.after(rem)
+        return None
+
     def _run_counted(self, request: pb.GenerateRequest) -> None:
         res = self.get_resources(InferResources)
         engine = res.generation_engines.get(request.model_name)
@@ -497,29 +512,53 @@ class GenerateContext(StreamingContext):
                         "priority and logprobs require a continuous-batching "
                         "backend")))
             return
+        deadline = self._deadline_of(request)
         try:
             stops = set(request.stop_tokens)
             with engine.start_session(
                     timeout=self.SESSION_LEASE_TIMEOUT_S) as session:
-                session.prefill(np.asarray(request.prompt, np.int32))
-                for i, tok in enumerate(session.stream(request.steps)):
+                try:
+                    # PRE-STREAM validation only (ADVICE r5): engines
+                    # validate prompt bounds/lengths eagerly at prefill/
+                    # stream-creation, so a ValueError HERE is a
+                    # deterministic request error — INVALID_ARGUMENT, and
+                    # routers don't fail the identical doomed request over.
+                    # A ValueError raised LATER, mid-iteration, is an
+                    # internal fault and falls through to INTERNAL
+                    # (retryable) below.
+                    session.prefill(np.asarray(request.prompt, np.int32))
+                    stream = session.stream(request.steps)
+                except ValueError as e:
+                    self.write(pb.GenerateResponse(
+                        final=True, status=pb.RequestStatus(
+                            code=pb.INVALID_ARGUMENT, message=str(e))))
+                    return
+                for i, tok in enumerate(stream):
+                    if deadline is not None and deadline.expired():
+                        # cancelled before the next token step; leaving the
+                        # with-block frees the session slot NOW
+                        log.info("generation deadline exceeded at step %d", i)
+                        self.write(pb.GenerateResponse(
+                            final=True, status=pb.RequestStatus(
+                                code=pb.DEADLINE_EXCEEDED,
+                                message="deadline exceeded mid-stream")))
+                        return
                     if (self.grpc_context is not None
                             and hasattr(self.grpc_context, "is_active")
                             and not self.grpc_context.is_active()):
                         log.info("generation cancelled by client at step %d", i)
                         return  # free the session slot immediately
+                    # chaos: per-token server fault site (error = transient
+                    # stream failure; kill = replica process death)
+                    chaos.trip("rpc.server.generate_token")
                     self.write(pb.GenerateResponse(token=tok, index=i))
                     if tok in stops:
                         break  # stop token emitted; end like the paged path
             self.write(pb.GenerateResponse(
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
-        except ValueError as e:
-            # deterministic request errors (length/steps/id bounds): the
-            # same on every replica — INVALID_ARGUMENT so routers don't
-            # fail the identical doomed request over (GenerationRejected
-            # retryable contract)
+        except DeadlineExceeded as e:
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
-                code=pb.INVALID_ARGUMENT, message=str(e))))
+                code=pb.DEADLINE_EXCEEDED, message=str(e))))
         except Exception as e:  # noqa: BLE001
             log.exception("generation failed")
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
@@ -541,6 +580,7 @@ class GenerateContext(StreamingContext):
                     logprob=0.0 if logprob is None else float(logprob)))
 
         fut = None
+        deadline = self._deadline_of(request)
         try:
             sampling = None
             if request.temperature > 0.0:
@@ -550,19 +590,27 @@ class GenerateContext(StreamingContext):
                     top_p=request.top_p,
                     seed=request.seed if request.HasField("seed") else None,
                     device=request.device_sampling)
+            kw = {}
+            if deadline is not None:
+                # the batcher's tick sweep enforces it (lane/pages free
+                # before the next step); only passed when present so
+                # wrapped/test engines without the kwarg keep working
+                kw["deadline"] = deadline
             fut = engine.submit(np.asarray(request.prompt, np.int32),
                                 request.steps, on_token=on_token,
                                 sampling=sampling,
                                 priority=request.priority,
                                 stop_tokens=list(request.stop_tokens),
-                                logprobs=request.return_logprobs)
-            deadline = _time.monotonic() + self.SESSION_LEASE_TIMEOUT_S
+                                logprobs=request.return_logprobs, **kw)
+            lease_deadline = _time.monotonic() + self.SESSION_LEASE_TIMEOUT_S
             while True:
                 try:
                     fut.result(timeout=1.0)
                     break
+                except DeadlineExceeded:
+                    raise  # NOT a poll timeout (TimeoutError subclass!)
                 except _f.TimeoutError:
-                    if _time.monotonic() > deadline:
+                    if _time.monotonic() > lease_deadline:
                         raise
                     if (self.grpc_context is not None
                             and hasattr(self.grpc_context, "is_active")
@@ -573,6 +621,10 @@ class GenerateContext(StreamingContext):
             finished[0] = True
             self.write(pb.GenerateResponse(
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
+        except DeadlineExceeded as e:
+            finished[0] = True
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.DEADLINE_EXCEEDED, message=str(e))))
         except ValueError as e:
             # submit()'s deterministic request validation (empty prompt,
             # steps, max_len, id bounds): INVALID_ARGUMENT, not INTERNAL —
@@ -606,8 +658,10 @@ class GenerationRejected(RuntimeError):
     @property
     def retryable(self) -> bool:
         """INTERNAL may be a transient engine fault; deterministic
-        request errors are not worth a second replica's time."""
-        return self.code not in (pb.UNKNOWN_MODEL, pb.INVALID_ARGUMENT)
+        request errors are not worth a second replica's time, and an
+        expired deadline is a GLOBAL budget — no replica can beat it."""
+        return self.code not in (pb.UNKNOWN_MODEL, pb.INVALID_ARGUMENT,
+                                 pb.DEADLINE_EXCEEDED)
 
 
 class GenerateStreamClient:
@@ -621,14 +675,32 @@ class GenerateStreamClient:
                  priority: int = 0, temperature: float = 0.0,
                  top_k: int = 0, seed: Optional[int] = None,
                  stop_tokens=(), device_sampling: bool = False,
-                 return_logprobs: bool = False, top_p: float = 0.0):
+                 return_logprobs: bool = False, top_p: float = 0.0,
+                 deadline_s: Optional[float] = None):
         """Yields token ids; with ``return_logprobs=True`` yields
-        ``(token, logprob)`` pairs instead."""
+        ``(token, logprob)`` pairs instead.
+
+        ``deadline_s`` is the request's END-TO-END budget: the remaining
+        budget rides request metadata (``deadline_ms``) so the server
+        cancels the decode before its next token step, the gRPC stream
+        carries it as the transport deadline (backstop), and expiry here
+        raises :class:`~tpulab.core.deadline.DeadlineExceeded`.
+        ``timeout`` remains the per-activity stall bound (no stream
+        progress for that long = the replica is stuck)."""
         import queue as _q
+        deadline = Deadline.after(deadline_s)
         out: "_q.Queue" = _q.Queue()
+        # transport deadline trails the APP deadline slightly so the
+        # server's clean DEADLINE_EXCEEDED status normally wins the race
+        # and the hard gRPC kill is only the backstop.  The stall
+        # ``timeout`` deliberately does NOT become a transport deadline: a
+        # healthy stream may run longer than any single-activity bound.
+        rem0 = deadline.remaining()
         stream = ClientStreaming(
             self._manager._executor, f"/{SERVICE_NAME}/Generate", out.put,
-            pb.GenerateRequest.SerializeToString, pb.GenerateResponse.FromString)
+            pb.GenerateRequest.SerializeToString,
+            pb.GenerateResponse.FromString,
+            timeout=None if rem0 is None else rem0 + 2.0)
         # a dead stream must wake the consumer promptly, not via timeout
         _STREAM_DEAD = object()
         stream.done().add_done_callback(lambda _f: out.put(_STREAM_DEAD))
@@ -642,12 +714,24 @@ class GenerateStreamClient:
             return_logprobs=return_logprobs)
         if seed is not None:
             req.seed = seed
+        rem = deadline.remaining()
+        if rem is not None:
+            # RELATIVE budget, never wall clock: replica clocks differ
+            req.deadline_ms = max(1, int(rem * 1e3))
         stream.write(req)
         stream.writes_done()
         finished = False
         try:
             while True:
-                resp = out.get(timeout=timeout)
+                deadline.check("generation")
+                try:
+                    resp = out.get(timeout=deadline.bound(timeout))
+                except _q.Empty:
+                    # finished stays False: the finally-cancel tears the
+                    # stalled stream down and frees the server slot
+                    deadline.check("generation")
+                    raise TimeoutError(
+                        f"no generation stream activity within {timeout}s")
                 if resp is _STREAM_DEAD:
                     finished = True
                     exc = stream.done().exception()
@@ -655,6 +739,9 @@ class GenerateStreamClient:
                         "generation stream closed before completion"))
                 if resp.final:
                     finished = True
+                    if resp.status.code == pb.DEADLINE_EXCEEDED:
+                        raise DeadlineExceeded(resp.status.message
+                                               or "deadline exceeded")
                     if resp.status.code not in (pb.SUCCESS, 0):
                         raise GenerationRejected(resp.status.code,
                                                  resp.status.message)
@@ -691,14 +778,19 @@ class RemoteInferenceManager:
     def health_async(self):
         return self._health.start(pb.HealthRequest())
 
-    def get_models(self) -> Dict[str, pb.ModelStatus]:
-        resp = self._status.call(pb.StatusRequest())
+    def get_models(self,
+                   timeout: Optional[float] = None) -> Dict[str, pb.ModelStatus]:
+        resp = self._status.call(pb.StatusRequest(), timeout=timeout)
         if resp.status.code != pb.SUCCESS:
             raise RuntimeError(f"Status failed: {resp.status.message}")
         return {m.name: m for m in resp.models}
 
-    def infer_runner(self, model_name: str) -> "InferRemoteRunner":
-        models = self.get_models()
+    def infer_runner(self, model_name: str,
+                     timeout: Optional[float] = None) -> "InferRemoteRunner":
+        """``timeout`` bounds the first-contact Status RPC — an
+        UNRESPONSIVE (black-holed, not refusing) endpoint must not hang
+        construction past the caller's budget."""
+        models = self.get_models(timeout=timeout)
         if model_name not in models:
             raise KeyError(f"unknown remote model {model_name!r}")
         return InferRemoteRunner(self, model_name, models[model_name])
@@ -798,17 +890,23 @@ class InferRemoteRunner:
         return {s.name: (tuple(s.dims), np.dtype(s.dtype))
                 for s in self.status.outputs}
 
-    def infer(self, requested_outputs=None, **arrays: np.ndarray):
+    def infer(self, requested_outputs=None, timeout=None,
+              **arrays: np.ndarray):
         """Future of dict-of-numpy outputs.
 
         ``requested_outputs`` optionally names a subset of the model's
         outputs; unknown names fail the request with INVALID_ARGUMENT.
-        A model input that is literally named ``requested_outputs`` still
-        works: an ndarray value is rebound as an input array.
+        ``timeout`` (seconds) becomes the call's gRPC deadline — the
+        per-attempt budget replica routers derive from an end-to-end
+        deadline.  Model inputs literally named ``requested_outputs`` or
+        ``timeout`` still work: ndarray values are rebound as inputs.
         """
         if isinstance(requested_outputs, np.ndarray):
             arrays["requested_outputs"] = requested_outputs
             requested_outputs = None
+        if isinstance(timeout, np.ndarray):
+            arrays["timeout"] = timeout
+            timeout = None
         if not arrays:
             raise ValueError("no input arrays")
         batch = next(iter(arrays.values())).shape[0]
@@ -825,4 +923,4 @@ class InferRemoteRunner:
                     f"{resp.status.message}")
             return {t.name: proto_to_tensor(t) for t in resp.outputs}
 
-        return self._mgr._infer.start(req, on_complete)
+        return self._mgr._infer.start(req, on_complete, timeout=timeout)

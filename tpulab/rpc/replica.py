@@ -9,6 +9,27 @@ health-checks them, routes each request to the least-loaded live replica
 and fails a request over to the next replica when one dies mid-flight
 (inference is idempotent — a retry cannot corrupt state).
 
+Circuit breaker (beyond-reference; the resilience-balancing argument of
+the adaptive-orchestration line in PAPERS.md): per-replica failure streaks
+eject a replica from routing after ``breaker_threshold`` consecutive
+faults (state *open*), a lazily-started background prober re-checks it
+over the existing ``health`` RPC with exponential backoff (state
+*probing*), and a passing probe — or a success from fallback traffic —
+restores it (state *closed*).  Steady-state traffic therefore never waits
+on a known-dead endpoint: the dead replica is skipped at pick time
+instead of being re-discovered (and timed out on) per request.  When
+EVERY candidate is open the pick falls back to the open ones — an
+all-dead set must still attempt traffic rather than refuse it.
+
+Deadlines: ``infer(deadline_s=...)`` / ``generate(deadline_s=...)`` bound
+the request END TO END.  Each unary attempt gets an even split of the
+remaining budget (``Deadline.per_attempt``) as its gRPC deadline, so one
+black-holed replica cannot eat the whole budget; generation attempts
+carry the remaining budget to the server (``GenerateRequest.deadline_ms``)
+so the engine cancels before its next token step.  Expiry raises
+:class:`~tpulab.core.deadline.DeadlineExceeded` and is NEVER failed over
+— the budget is global, no replica can beat it.
+
 :class:`GenerationReplicaSet` extends the same routing to token-streaming
 generation (beyond-reference: the trtlab serving surface has no
 generation path).  Failover here must respect server-side state: a
@@ -28,21 +49,30 @@ multihost serving test drives across two jax.distributed processes.
 
 from __future__ import annotations
 
+import logging
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
+from tpulab.core.deadline import Deadline, DeadlineExceeded
 from tpulab.rpc.infer_service import (GenerateStreamClient,
                                       RemoteInferenceManager)
+
+log = logging.getLogger("tpulab.rpc")
 
 
 class _BaseReplicaSet:
     """Shared routing state: least-loaded pick with round-robin
-    tie-breaking, per-replica health, inflight/served accounting."""
+    tie-breaking, per-replica health + circuit breaker, inflight/served
+    accounting."""
 
     def __init__(self, addresses: Sequence[str], model_name: str,
                  channels: int = 1, max_failover: Optional[int] = None,
-                 metrics=None):
+                 metrics=None, breaker_threshold: int = 3,
+                 probe_backoff_s: float = 0.25,
+                 probe_backoff_cap_s: float = 30.0,
+                 probe_timeout_s: float = 5.0):
         if not addresses:
             raise ValueError("need at least one replica address")
         self.addresses = list(addresses)
@@ -56,6 +86,23 @@ class _BaseReplicaSet:
         self._rr = 0  # tie-break rotation cursor
         self._max_failover = (len(self._managers) if max_failover is None
                               else max_failover)
+        # -- circuit breaker (0/None disables) ------------------------------
+        self._cb_threshold = breaker_threshold or 0
+        self._fail_streak = [0] * len(self._managers)
+        self._open: set = set()        # ejected replica indices
+        self._probing: set = set()     # currently being re-probed
+        self._probe_backoff_s = probe_backoff_s
+        self._probe_backoff_cap_s = probe_backoff_cap_s
+        self._probe_timeout_s = probe_timeout_s
+        self._probe_next: Dict[int, float] = {}      # idx -> monotonic due
+        self._probe_interval: Dict[int, float] = {}  # idx -> current backoff
+        # the probe thread is created LAZILY on first ejection: a healthy
+        # set runs zero extra threads (steady state pays nothing)
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_wake = threading.Event()
+        self._probe_stop = False
+        #: cumulative breaker ejections (observability / test assertions)
+        self.ejections = 0
         #: optional :class:`tpulab.utils.metrics.ReplicaSetMetrics`
         self._metrics = metrics
         if metrics is not None:
@@ -83,11 +130,123 @@ class _BaseReplicaSet:
         if self._metrics is not None:
             self._metrics.failovers.inc()
 
+    # -- circuit breaker ----------------------------------------------------
+    def breaker_states(self) -> Dict[str, str]:
+        """Per-replica breaker state: ``closed`` (routing normally),
+        ``open`` (ejected), ``probing`` (ejected, re-probe in flight)."""
+        with self._lock:
+            return {a: ("probing" if i in self._probing
+                        else "open" if i in self._open else "closed")
+                    for i, a in enumerate(self.addresses)}
+
+    def _record_success(self, idx: int) -> None:
+        """A completed request (or deterministic app-level rejection):
+        resets the streak and closes the circuit if fallback traffic
+        reached an ejected replica successfully."""
+        if not self._cb_threshold:
+            return
+        with self._lock:
+            self._fail_streak[idx] = 0
+            if idx in self._open:
+                self._restore_locked(idx, "traffic")
+
+    def _record_failure(self, idx: int) -> None:
+        """A replica fault (transport error, timeout, retryable engine
+        failure).  ``breaker_threshold`` consecutive faults eject."""
+        if not self._cb_threshold:
+            return
+        eject = False
+        with self._lock:
+            self._fail_streak[idx] += 1
+            if (self._fail_streak[idx] >= self._cb_threshold
+                    and idx not in self._open):
+                self._open.add(idx)
+                self._probe_interval[idx] = self._probe_backoff_s
+                self._probe_next[idx] = (time.monotonic()
+                                         + self._probe_backoff_s)
+                self.ejections += 1
+                eject = True
+        if eject:
+            log.warning("replica %s ejected after %d consecutive failures; "
+                        "background probe armed", self.addresses[idx],
+                        self._cb_threshold)
+            self._ensure_probe_thread()
+            self._probe_wake.set()
+
+    def _restore_locked(self, idx: int, how: str) -> None:
+        """CALLER HOLDS self._lock."""
+        self._open.discard(idx)
+        self._probing.discard(idx)
+        self._fail_streak[idx] = 0
+        self._probe_next.pop(idx, None)
+        self._probe_interval.pop(idx, None)
+        log.info("replica %s restored to rotation (%s)",
+                 self.addresses[idx], how)
+
+    def _ensure_probe_thread(self) -> None:
+        with self._lock:
+            if self._probe_thread is not None and self._probe_thread.is_alive():
+                return
+            if self._probe_stop:
+                return
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="replica-probe", daemon=True)
+            self._probe_thread.start()
+
+    def _probe_loop(self) -> None:
+        """Re-probe ejected replicas over the existing health RPC with
+        per-replica exponential backoff; exits only at close()."""
+        while True:
+            with self._lock:
+                if self._probe_stop:
+                    return
+                targets = sorted(self._open - self._probing)
+            if not targets:
+                self._probe_wake.wait(timeout=1.0)
+                self._probe_wake.clear()
+                continue
+            now = time.monotonic()
+            due = [i for i in targets
+                   if self._probe_next.get(i, 0.0) <= now]
+            if not due:
+                soonest = min(self._probe_next.get(i, now) for i in targets)
+                self._probe_wake.wait(timeout=min(1.0, max(0.01,
+                                                           soonest - now)))
+                self._probe_wake.clear()
+                continue
+            for idx in due:
+                with self._lock:
+                    if self._probe_stop:
+                        return
+                    if idx not in self._open:
+                        continue
+                    self._probing.add(idx)
+                ok = False
+                try:
+                    resp = self._managers[idx].health_async().result(
+                        timeout=self._probe_timeout_s)
+                    ok = bool(resp.live and resp.ready)
+                except Exception:  # noqa: BLE001 - still dead is data
+                    ok = False
+                with self._lock:
+                    self._probing.discard(idx)
+                    if idx not in self._open:
+                        continue  # restored by traffic while we probed
+                    if ok:
+                        self._restore_locked(idx, "background probe")
+                    else:
+                        iv = min(self._probe_interval.get(
+                            idx, self._probe_backoff_s) * 2,
+                            self._probe_backoff_cap_s)
+                        self._probe_interval[idx] = iv
+                        self._probe_next[idx] = time.monotonic() + iv
+
     # -- health -------------------------------------------------------------
     def health(self, timeout: float = 10.0) -> Dict[str, dict]:
         """Per-replica liveness/readiness (exceptions become dead
         entries rather than raising — the set is expected to outlive
-        individual replicas)."""
+        individual replicas).  A live+ready result also closes that
+        replica's circuit: an explicit health() IS a probe."""
         out: Dict[str, dict] = {}
         futs = []
         for a, m in zip(self.addresses, self._managers):
@@ -103,6 +262,12 @@ class _BaseReplicaSet:
             except Exception as e:  # noqa: BLE001 - dead replica is data
                 out[addr] = {"live": False, "ready": False,
                              "error": f"{type(e).__name__}: {e}"}
+        with self._lock:
+            for i, a in enumerate(self.addresses):
+                h = out.get(a)
+                if (h is not None and h["live"] and h["ready"]
+                        and i in self._open):
+                    self._restore_locked(i, "health()")
         if self._metrics is not None:
             for addr, h in out.items():  # cold path: .labels() is fine here
                 self._metrics.live.labels(replica=addr).set(
@@ -113,10 +278,16 @@ class _BaseReplicaSet:
     def _pick_locked(self, exclude: frozenset) -> Optional[int]:
         """Least-loaded with round-robin tie-breaking (sequential traffic
         rotates instead of piling onto index 0 — envoy's round-robin
-        behavior at the tie).  CALLER HOLDS self._lock; does NOT bump
-        inflight — the single shared selection algorithm."""
+        behavior at the tie).  Breaker-open replicas are skipped, UNLESS
+        every non-excluded replica is open (an all-dead set still
+        attempts traffic — the attempt doubles as a live probe).
+        CALLER HOLDS self._lock; does NOT bump inflight — the single
+        shared selection algorithm."""
         candidates = [(n, i) for i, n in enumerate(self._inflight)
-                      if i not in exclude]
+                      if i not in exclude and i not in self._open]
+        if not candidates:
+            candidates = [(n, i) for i, n in enumerate(self._inflight)
+                          if i not in exclude]
         if not candidates:
             return None
         lo = min(n for n, _ in candidates)
@@ -145,6 +316,12 @@ class _BaseReplicaSet:
             return list(self._inflight)
 
     def close(self) -> None:
+        with self._lock:
+            self._probe_stop = True
+            t = self._probe_thread
+        self._probe_wake.set()
+        if t is not None:
+            t.join(timeout=self._probe_timeout_s + 2.0)
         for m in self._managers:
             try:
                 m.close()
@@ -157,9 +334,9 @@ class ReplicaSet(_BaseReplicaSet):
 
     def __init__(self, addresses: Sequence[str], model_name: str,
                  channels: int = 1, max_failover: Optional[int] = None,
-                 metrics=None):
+                 metrics=None, **breaker_kw):
         super().__init__(addresses, model_name, channels, max_failover,
-                         metrics=metrics)
+                         metrics=metrics, **breaker_kw)
         # runners are built LAZILY per replica: constructing one performs a
         # blocking Status RPC, and a replica that is down at construction
         # (rolling restart) must count as a failed submission on that
@@ -170,26 +347,46 @@ class ReplicaSet(_BaseReplicaSet):
         # against _pick/_submit bookkeeping on the shared lock
         self._runner_locks = [threading.Lock() for _ in self._managers]
 
-    def _runner(self, idx: int):
+    def _runner(self, idx: int, timeout: Optional[float] = None):
         """The replica's runner, built on first use (raises if the replica
-        is unreachable — the caller treats that as a failed submission)."""
+        is unreachable — the caller treats that as a failed submission).
+        ``timeout`` bounds the first-contact Status RPC so a black-holed
+        replica cannot eat more than one attempt's budget."""
         with self._runner_locks[idx]:
             r = self._runners[idx]
             if r is None:
-                r = self._managers[idx].infer_runner(self.model_name)
+                r = self._managers[idx].infer_runner(self.model_name,
+                                                     timeout=timeout)
                 self._runners[idx] = r
             return r
 
-    def infer(self, **arrays) -> Future:
+    def infer(self, deadline_s: Optional[float] = None, **arrays) -> Future:
         """Future of the outputs dict; rides the least-loaded replica and
-        fails over (re-submits) when a replica errors mid-flight."""
+        fails over (re-submits) when a replica errors mid-flight.
+
+        ``deadline_s`` bounds the request END TO END: each attempt gets an
+        even split of the remaining budget as its gRPC deadline
+        (``Deadline.per_attempt``), so a black-holed replica cannot eat
+        the whole budget, and expiry fails the future with
+        :class:`DeadlineExceeded` instead of retrying.  A model input
+        literally named ``deadline_s`` still works: an ndarray value is
+        rebound as an input array."""
+        import numpy as _np
+        if isinstance(deadline_s, _np.ndarray):
+            arrays["deadline_s"] = deadline_s
+            deadline_s = None
         outer: Future = Future()
         self._submit(outer, arrays, attempts_left=self._max_failover,
-                     exclude=frozenset())
+                     exclude=frozenset(), deadline=Deadline.after(deadline_s))
         return outer
 
     def _submit(self, outer: Future, arrays: dict, attempts_left: int,
-                exclude: frozenset) -> None:
+                exclude: frozenset, deadline: Deadline) -> None:
+        if deadline.expired():
+            if not outer.done():
+                outer.set_exception(
+                    DeadlineExceeded("inference deadline exceeded"))
+            return
         idx = self._pick_or_any(exclude)
         if idx is None:  # unreachable: >=1 replica by construction
             outer.set_exception(RuntimeError("no replicas"))
@@ -201,30 +398,39 @@ class ReplicaSet(_BaseReplicaSet):
                 self._note_inflight(idx)
             exc = fut.exception()
             if exc is None:
+                self._record_success(idx)
                 with self._lock:
                     self.served[idx] += 1
                 self._note_served(idx)
                 if not outer.done():
                     outer.set_result(fut.result())
                 return
-            if attempts_left > 1 and not outer.done():
+            self._record_failure(idx)
+            if deadline.expired():
+                if not outer.done():
+                    outer.set_exception(
+                        DeadlineExceeded("inference deadline exceeded"))
+            elif attempts_left > 1 and not outer.done():
                 self._note_failover()
                 self._submit(outer, arrays, attempts_left - 1,
-                             exclude | {idx})
+                             exclude | {idx}, deadline)
             elif not outer.done():
                 outer.set_exception(exc)
 
         try:
-            self._runner(idx).infer(**arrays).add_done_callback(on_done)
+            budget = deadline.per_attempt(attempts_left)
+            self._runner(idx, timeout=budget).infer(
+                timeout=budget, **arrays).add_done_callback(on_done)
         except Exception as e:  # submission itself failed (dead channel
             #                     or unreachable at first contact)
             with self._lock:
                 self._inflight[idx] -= 1
                 self._note_inflight(idx)
-            if attempts_left > 1:
+            self._record_failure(idx)
+            if attempts_left > 1 and not deadline.expired():
                 self._note_failover()
                 self._submit(outer, arrays, attempts_left - 1,
-                             exclude | {idx})
+                             exclude | {idx}, deadline)
             else:
                 outer.set_exception(e)
 
@@ -247,9 +453,9 @@ class GenerationReplicaSet(_BaseReplicaSet):
     def __init__(self, addresses: Sequence[str], model_name: str,
                  channels: int = 1, max_failover: Optional[int] = None,
                  prefix_affinity: bool = False, affinity_tokens: int = 32,
-                 affinity_slack: int = 2, metrics=None):
+                 affinity_slack: int = 2, metrics=None, **breaker_kw):
         super().__init__(addresses, model_name, channels, max_failover,
-                         metrics=metrics)
+                         metrics=metrics, **breaker_kw)
         self._clients = [GenerateStreamClient(m, model_name)
                         for m in self._managers]
         self.prefix_affinity = prefix_affinity
@@ -274,37 +480,48 @@ class GenerationReplicaSet(_BaseReplicaSet):
                      if i not in exclude]
             if not loads:  # every replica already failed this request
                 idx = self._pick_locked(frozenset())
-            elif (pref not in exclude
+            elif (pref not in exclude and pref not in self._open
                     and self._inflight[pref] <= min(loads)
                     + self.affinity_slack):
                 idx = pref
-            else:  # overloaded/dead home: shared least-loaded policy
+            else:  # overloaded/ejected/dead home: shared least-loaded policy
                 idx = self._pick_locked(exclude)
             if idx is not None:
                 self._inflight[idx] += 1
                 self._note_inflight(idx)
             return idx
 
-    def generate(self, prompt, steps: int, timeout: float = 300.0, **kw):
+    def generate(self, prompt, steps: int, timeout: float = 300.0,
+                 deadline_s: Optional[float] = None, **kw):
         """Token iterator with transparent failover.
 
         Sampling without an explicit seed gets a client-side one so a
         replayed request reproduces the identical token sequence on any
         replica; tokens already delivered are skipped on replay, so the
         consumer sees each position exactly once.
+
+        ``deadline_s`` bounds the stream END TO END: every (re)attempt
+        carries the remaining budget to the server (the engine cancels
+        before its next token step) and expiry raises
+        :class:`DeadlineExceeded` — never failed over, the budget is
+        global.  ``timeout`` stays the per-activity stall bound.
         """
         import numpy as np
         if kw.get("temperature", 0.0) and kw.get("seed") is None:
             import secrets
             kw["seed"] = secrets.randbits(63)
+        if deadline_s is not None:
+            kw["deadline_s"] = deadline_s
         prompt = list(np.asarray(prompt, np.int32))
         return self._generate_iter(prompt, steps, timeout, kw)
 
     def _generate_iter(self, prompt, steps, timeout, kw):
+        deadline = Deadline.after(kw.pop("deadline_s", None))
         delivered = 0
         attempts_left = self._max_failover
         exclude: set = set()
         while True:
+            deadline.check("generation")
             if self.prefix_affinity:
                 idx = self._pick_affine(prompt, frozenset(exclude))
             else:
@@ -313,8 +530,12 @@ class GenerationReplicaSet(_BaseReplicaSet):
                 raise RuntimeError("no replicas")
             gen = None
             try:
-                gen = self._clients[idx].generate(prompt, steps,
-                                                  timeout=timeout, **kw)
+                akw = dict(kw)
+                rem = deadline.remaining()
+                if rem is not None:
+                    akw["deadline_s"] = rem  # per-attempt = what's left
+                gen = self._clients[idx].generate(
+                    prompt, steps, timeout=deadline.bound(timeout), **akw)
                 i = 0
                 for item in gen:
                     if i >= delivered:  # replay skips what the consumer has
@@ -323,6 +544,7 @@ class GenerationReplicaSet(_BaseReplicaSet):
                     i += 1
                 with self._lock:
                     self.served[idx] += 1
+                self._record_success(idx)
                 self._note_served(idx)
                 return
             except Exception as e:
@@ -330,7 +552,12 @@ class GenerationReplicaSet(_BaseReplicaSet):
                 if isinstance(e, GenerationRejected) and not e.retryable:
                     # the server processed and rejected the request —
                     # identical on every replica, don't burn them all
+                    # (and don't trip the breaker: the replica is fine)
+                    self._record_success(idx)
                     raise
+                if isinstance(e, DeadlineExceeded):
+                    raise  # global budget spent: no replica can beat it
+                self._record_failure(idx)
                 attempts_left -= 1
                 exclude.add(idx)
                 if attempts_left <= 0:
