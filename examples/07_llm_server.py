@@ -167,8 +167,12 @@ def main():
                                           start_metrics_server)
         gm = GenerationMetrics()
         start_metrics_server(gm, port=args.metrics_port)
+        # latency distributions (TTFT/ITL/queue/e2e) are event-driven: the
+        # batcher observes them per completed request at the source
+        cb.metrics = gm
 
         def poll_loop():
+            # gauges/counters still ride the cheap 2 s poll
             while True:
                 try:
                     gm.poll(cb)

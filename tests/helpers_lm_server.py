@@ -5,7 +5,12 @@ until killed.  Companion of tests/test_replica.py's in-process
 ``_serve_lm`` — this variant exists so a test can ``SIGKILL`` a real
 process (TCP reset, no grace) rather than call ``shutdown(grace_s=0)``.
 
-    python tests/helpers_lm_server.py [--delay-ms 50]
+    python tests/helpers_lm_server.py [--delay-ms 50] [--trace-path F]
+
+``--trace-path`` attaches a ChromeTraceRecorder to the server and
+autosaves it (atomically) every 100 ms — the parent test polls the file
+and merges it with its own client-side trace into one timeline (the
+process may be SIGKILLed at any moment, so there is no clean-exit save).
 """
 
 from __future__ import annotations
@@ -50,6 +55,7 @@ class PacedEngine:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--delay-ms", type=float, default=50.0)
+    ap.add_argument("--trace-path", default=None)
     args = ap.parse_args()
 
     from tpulab.tpu.platform import force_cpu
@@ -64,9 +70,23 @@ def main() -> int:
                                      n_layers=2, d_ff=64)  # seed=0 default
     eng = GenerationEngine(params, n_heads=2, n_layers=2, max_len=64,
                            max_sessions=2, compute_dtype=jnp.float32)
+    trace = None
+    if args.trace_path:
+        import threading
+
+        from tpulab.utils.tracing import ChromeTraceRecorder
+        trace = ChromeTraceRecorder(process_name="lm-server")
+
+        def autosave():
+            while True:
+                time.sleep(0.1)
+                if len(trace):
+                    trace.save(args.trace_path)
+        threading.Thread(target=autosave, daemon=True).start()
+
     mgr = tpulab.InferenceManager(max_exec_concurrency=1)
     mgr.serve(port=0, generation_engines={
-        "lm": PacedEngine(eng, args.delay_ms / 1e3)})
+        "lm": PacedEngine(eng, args.delay_ms / 1e3)}, trace=trace)
     print(f"PORT {mgr.server.bound_port}", flush=True)
     while True:          # killed by the parent test
         time.sleep(1.0)

@@ -462,6 +462,126 @@ def test_generation_prefix_affinity_routing():
                 pass
 
 
+def test_breaker_chaos_metrics_failover_open_probe_restore():
+    """Drive a ReplicaSet through failover -> breaker-open -> probe-restore
+    under tpulab.chaos, and assert the exported resilience samples:
+    chaos-injection counters, per-attempt status codes, breaker state
+    one-hot + transition counters (open -> probing -> closed)."""
+    import time
+
+    from prometheus_client import CollectorRegistry
+
+    from tpulab import chaos
+    from tpulab.utils.metrics import ChaosMetrics, ReplicaSetMetrics
+
+    mgr_a, mgr_b = _serve_mnist(), _serve_mnist()
+    rs = None
+    cm = ChaosMetrics(registry=CollectorRegistry()).install()
+    try:
+        addrs = [f"127.0.0.1:{m.server.bound_port}" for m in (mgr_a, mgr_b)]
+        metrics = ReplicaSetMetrics(registry=CollectorRegistry())
+        rs = ReplicaSet(addrs, "mnist", metrics=metrics,
+                        breaker_threshold=1, probe_backoff_s=0.05,
+                        probe_backoff_cap_s=0.2)
+
+        def sample(name, labels=None):
+            return metrics.registry.get_sample_value(name, labels or {})
+
+        # both breakers start closed (one-hot state gauge)
+        for a in addrs:
+            assert sample("tpulab_replica_breaker_state",
+                          {"replica": a, "state": "closed"}) == 1
+            assert sample("tpulab_replica_breaker_state",
+                          {"replica": a, "state": "open"}) == 0
+        # ONE injected unary fault: the first attempt fails, the breaker
+        # (threshold 1) ejects that replica, the request fails over and
+        # completes on the other
+        with chaos.inject("rpc.client.unary=error+1") as sched:
+            rs.infer(Input3=X).result(timeout=60)
+            assert sched.fired("rpc.client.unary") == 1
+        assert cm.registry.get_sample_value(
+            "tpulab_chaos_injections_total",
+            {"point": "rpc.client.unary", "action": "error"}) == 1
+        assert sample("tpulab_replica_failovers_total") == 1
+        assert sample("tpulab_replica_attempts_total",
+                      {"code": "ChaosError"}) == 1
+        assert sample("tpulab_replica_attempts_total", {"code": "OK"}) == 1
+        ejected = [a for a, s in rs.breaker_states().items()
+                   if s != "closed"]
+        assert len(ejected) == 1
+        assert sample("tpulab_replica_breaker_transitions_total",
+                      {"replica": ejected[0], "to": "open"}) == 1
+        # the background probe (healthy replica, short backoff) restores it
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if rs.breaker_states()[ejected[0]] == "closed":
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("breaker never closed via probe")
+        assert sample("tpulab_replica_breaker_transitions_total",
+                      {"replica": ejected[0], "to": "probing"}) >= 1
+        assert sample("tpulab_replica_breaker_transitions_total",
+                      {"replica": ejected[0], "to": "closed"}) >= 1
+        assert sample("tpulab_replica_breaker_state",
+                      {"replica": ejected[0], "state": "closed"}) == 1
+        assert sample("tpulab_replica_breaker_state",
+                      {"replica": ejected[0], "state": "open"}) == 0
+    finally:
+        cm.uninstall()
+        if rs is not None:
+            rs.close()
+        mgr_a.shutdown()
+        mgr_b.shutdown()
+
+
+def test_deadline_outcome_metrics():
+    """Deadline-bounded requests export met/exceeded outcomes and a
+    slack-at-completion histogram (client-side, both request kinds)."""
+    from prometheus_client import CollectorRegistry
+
+    from tpulab.core.deadline import DeadlineExceeded
+    from tpulab.rpc.replica import GenerationReplicaSet
+    from tpulab.utils.metrics import ReplicaSetMetrics
+
+    mgr, _ = _serve_lm()
+    grs = rs = None
+    try:
+        metrics = ReplicaSetMetrics(registry=CollectorRegistry())
+        addr = f"127.0.0.1:{mgr.server.bound_port}"
+        grs = GenerationReplicaSet([addr], "lm", metrics=metrics)
+        rs = ReplicaSet([addr], "mnist", metrics=metrics)
+
+        def sample(name, labels=None):
+            return metrics.registry.get_sample_value(name, labels or {})
+
+        # generous budgets: met + a slack observation each
+        list(grs.generate(np.arange(4, dtype=np.int32), 4, deadline_s=60.0))
+        rs.infer(deadline_s=60.0, Input3=X).result(timeout=60)
+        assert sample("tpulab_deadline_outcomes_total",
+                      {"outcome": "met"}) == 2
+        assert sample("tpulab_deadline_slack_seconds_count") == 2
+        # an already-spent budget: exceeded on both paths
+        with pytest.raises(DeadlineExceeded):
+            rs.infer(deadline_s=0.0, Input3=X).result(timeout=60)
+        with pytest.raises(DeadlineExceeded):
+            list(grs.generate(np.arange(4, dtype=np.int32), 4,
+                              deadline_s=0.0))
+        assert sample("tpulab_deadline_outcomes_total",
+                      {"outcome": "exceeded"}) >= 1
+        # unbounded requests must NOT report a vacuous 'met'
+        before = sample("tpulab_deadline_outcomes_total",
+                        {"outcome": "met"})
+        rs.infer(Input3=X).result(timeout=60)
+        assert sample("tpulab_deadline_outcomes_total",
+                      {"outcome": "met"}) == before
+    finally:
+        for s in (grs, rs):
+            if s is not None:
+                s.close()
+        mgr.shutdown()
+
+
 def test_replicaset_metrics_export():
     """ReplicaSetMetrics: per-replica traffic/inflight/live + failovers
     reach the registry through routing, failover, and health probes."""

@@ -98,3 +98,242 @@ def test_chrome_trace_ring_bound():
     for i in range(50):
         rec.add_span("s", t, 0.001, tid=1, i=i)
     assert len(rec) == 10
+
+
+# ------------------------------------------------ distributed tracing ----
+def test_trace_context_mint_and_metadata_roundtrip():
+    from tpulab.utils.tracing import TRACE_METADATA_KEY, TraceContext
+    tc = TraceContext()
+    assert len(tc.trace_id) == 16 and tc.trace_id != TraceContext().trace_id
+    md = tc.metadata()
+    assert dict(md)[TRACE_METADATA_KEY] == tc.trace_id
+    assert TraceContext.from_metadata(md).trace_id == tc.trace_id
+    assert TraceContext.from_metadata(()) is None
+    # server-side recovery: request field first, metadata fallback
+    from tpulab.rpc.protos import inference_pb2 as pb
+    req = pb.GenerateRequest(trace_id=tc.trace_id)
+    assert TraceContext.of_request(req).trace_id == tc.trace_id
+
+    class Ctx:
+        def invocation_metadata(self):
+            return md
+    assert TraceContext.of_request(pb.GenerateRequest(),
+                                   Ctx()).trace_id == tc.trace_id
+    assert TraceContext.of_request(pb.GenerateRequest()) is None
+
+
+def test_merge_chrome_traces_rebases_clocks(tmp_path):
+    """Per-process traces merge onto ONE wall-clock axis: each file's
+    epoch anchor shifts its events, so a span recorded 1 s later in
+    another process lands 1 s later in the merged timeline."""
+    import json
+    import time as _t
+    from tpulab.utils.tracing import ChromeTraceRecorder, merge_chrome_traces
+    r1 = ChromeTraceRecorder(process_name="client")
+    r2 = ChromeTraceRecorder(process_name="server")
+    t = _t.perf_counter()
+    r1.add_span("a", t, 0.001, trace_id="rid1")
+    r2.add_span("b", t, 0.001, trace_id="rid1")
+    # simulate a process whose recorder was born 1 s earlier on the wall
+    # clock: its events must shift +1 s relative to the other's
+    r2._epoch0 = r1._epoch0 + 1.0
+    p1 = r1.save(str(tmp_path / "c.json"))
+    p2 = r2.save(str(tmp_path / "s.json"))
+    doc = json.load(open(merge_chrome_traces(
+        str(tmp_path / "m.json"), p1, p2)))
+    spans = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert set(spans) == {"a", "b"}
+    assert spans["b"]["ts"] - spans["a"]["ts"] == __import__(
+        "pytest").approx(1e6, rel=0.01)
+    # process_name metadata events survive the merge (perfetto labels)
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in metas} == {"client", "server"}
+
+
+def test_batcher_records_spans_and_latency_histograms():
+    """ContinuousBatcher telemetry at the source: queue/prefill/decode
+    spans tagged with the request's trace id, and TTFT/ITL/queue-wait/e2e
+    histograms observed per completed request (not polled)."""
+    import jax.numpy as jnp
+    from prometheus_client import CollectorRegistry
+
+    from tpulab.engine.paged import ContinuousBatcher
+    from tpulab.models.transformer import init_transformer_params
+    from tpulab.utils.metrics import GenerationMetrics
+    from tpulab.utils.tracing import ChromeTraceRecorder
+
+    params = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                     n_layers=2, d_ff=64)
+    rec = ChromeTraceRecorder(max_events=1000)
+    gm = GenerationMetrics(registry=CollectorRegistry())
+    cb = ContinuousBatcher(params, n_heads=2, n_layers=2, lanes=2,
+                           max_len=64, page_size=8,
+                           compute_dtype=jnp.float32, trace=rec, metrics=gm)
+    try:
+        steps = 12
+        futs = [cb.submit(np.arange(4, dtype=np.int32), steps,
+                          trace_id=f"rid{i}") for i in range(3)]
+        for f in futs:
+            assert len(f.result(timeout=120)) == steps
+    finally:
+        cb.shutdown()
+    with rec._lock:
+        events = list(rec._events)
+    by_rid = {}
+    for e in events:
+        rid = e.get("args", {}).get("trace_id")
+        if rid:
+            by_rid.setdefault(rid, set()).add(e["name"])
+    assert set(by_rid) == {"rid0", "rid1", "rid2"}
+    for names in by_rid.values():
+        assert {"queue_wait", "prefill", "decode"} <= names
+    s = gm.registry.get_sample_value
+    assert s("tpulab_llm_ttft_seconds_count") == 3
+    assert s("tpulab_llm_queue_wait_seconds_count") == 3
+    assert s("tpulab_llm_e2e_seconds_count") == 3
+    # every token after the first is an ITL sample
+    assert s("tpulab_llm_inter_token_seconds_count") == 3 * (steps - 1)
+    q = gm.ttft_quantiles()
+    assert q["p50"] > 0 and q["p99"] >= q["p50"]
+    assert gm.itl_quantiles()["p99"] > 0
+
+
+def test_metrics_aggregated_endpoint():
+    """One /metrics port exports InferenceMetrics + ReplicaSetMetrics +
+    GenerationMetrics + ChaosMetrics through the aggregating collector:
+    breaker-state, deadline-outcome, chaos-injection and TTFT/ITL
+    histogram samples all come back from a single scrape."""
+    import urllib.request
+
+    from prometheus_client import CollectorRegistry
+
+    from tests.conftest import free_port
+    from tpulab import chaos
+    from tpulab.utils.metrics import (ChaosMetrics, GenerationMetrics,
+                                      InferenceMetrics, ReplicaSetMetrics,
+                                      start_metrics_server)
+
+    im = InferenceMetrics(registry=CollectorRegistry())
+    rm = ReplicaSetMetrics(registry=CollectorRegistry())
+    gm = GenerationMetrics(registry=CollectorRegistry())
+    cm = ChaosMetrics(registry=CollectorRegistry())
+    im.observe_request(0.02, 0.01)
+    rm.note_breaker_transition("r0:1", "open")
+    rm.note_attempt("UNAVAILABLE")
+    rm.observe_deadline(True, slack_s=0.2)
+    gm.observe_ttft(0.05)
+    gm.observe_itl(0.003)
+    cm.install()
+    try:
+        with chaos.inject("engine.step=error+1"):
+            import pytest
+            with pytest.raises(chaos.ChaosError):
+                chaos.trip("engine.step")
+    finally:
+        cm.uninstall()
+    port = free_port()
+    start_metrics_server([im, rm, gm, cm], port=port)
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    for needle in (
+            'tpulab_request_total 1.0',
+            'tpulab_replica_breaker_state{replica="r0:1",state="open"} 1.0',
+            'tpulab_replica_breaker_transitions_total{replica="r0:1",'
+            'to="open"} 1.0',
+            'tpulab_replica_attempts_total{code="UNAVAILABLE"} 1.0',
+            'tpulab_deadline_outcomes_total{outcome="met"} 1.0',
+            'tpulab_deadline_slack_seconds_count 1.0',
+            'tpulab_chaos_injections_total{action="error",'
+            'point="engine.step"} 1.0',
+            'tpulab_llm_ttft_seconds_count 1.0',
+            'tpulab_llm_inter_token_seconds_count 1.0',
+    ):
+        assert needle in body, f"{needle!r} missing from /metrics"
+
+
+def test_two_process_merged_trace(tmp_path):
+    """Acceptance: a client ReplicaSet in THIS process driving an LM
+    server in ANOTHER process yields one merged Chrome trace where the
+    client's attempt span and the server's queue/prefill/decode spans
+    share one trace id (and two distinct pids)."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+    import time
+
+    from tpulab.rpc.replica import GenerationReplicaSet
+    from tpulab.utils.tracing import ChromeTraceRecorder, merge_chrome_traces
+
+    repo = __file__.rsplit("/tests/", 1)[0]
+    env = dict(os.environ, PYTHONPATH=repo)
+    server_trace = str(tmp_path / "server_trace.json")
+    proc = subprocess.Popen(
+        [_sys.executable, f"{repo}/tests/helpers_lm_server.py",
+         "--delay-ms", "5", "--trace-path", server_trace],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+    grs = None
+    try:
+        import select
+        deadline = time.monotonic() + 120
+        port = None
+        while time.monotonic() < deadline:
+            ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if not ready:
+                if proc.poll() is not None:
+                    break
+                continue
+            line = proc.stdout.readline()
+            if line == "":
+                break
+            if line.startswith("PORT "):
+                port = int(line.split()[1])
+                break
+        assert port is not None, proc.stderr.read()[-1500:]
+
+        client_trace = ChromeTraceRecorder(process_name="client")
+        grs = GenerationReplicaSet([f"127.0.0.1:{port}"], "lm",
+                                   trace=client_trace)
+        toks = list(grs.generate(np.arange(5, dtype=np.int32), 10))
+        assert len(toks) == 10
+        # the server autosaves every 100 ms and spans land as the request
+        # progresses (queue_wait first, respond last): wait until the
+        # WHOLE lifecycle is on disk, not just the first span
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if os.path.exists(server_trace):
+                try:
+                    got = {e["name"] for e in
+                           json.load(open(server_trace))["traceEvents"]}
+                    if {"queue_wait", "prefill", "decode",
+                            "respond"} <= got:
+                        break
+                except ValueError:
+                    pass  # autosave is atomic, but be lenient anyway
+            time.sleep(0.1)
+        client_path = client_trace.save(str(tmp_path / "client_trace.json"))
+        merged = merge_chrome_traces(str(tmp_path / "merged.json"),
+                                     client_path, server_trace)
+        doc = json.load(open(merged))
+        spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        by_rid = {}
+        for e in spans:
+            rid = e.get("args", {}).get("trace_id")
+            if rid:
+                by_rid.setdefault(rid, []).append(e)
+        # ONE request id carries both the client attempt span and the
+        # server's queue/prefill/decode spans, across two pids
+        rid, evs = next(iter(by_rid.items()))
+        names = {e["name"] for e in evs}
+        assert "attempt" in names, names
+        assert {"queue_wait", "prefill", "decode"} <= names, names
+        assert len({e["pid"] for e in evs}) == 2
+        att = next(e for e in evs if e["name"] == "attempt")
+        assert att["args"]["replica"] == f"127.0.0.1:{port}"
+        assert att["args"]["attempt"] == 0
+    finally:
+        if grs is not None:
+            grs.close()
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=30)

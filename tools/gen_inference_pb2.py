@@ -60,6 +60,10 @@ def build_file() -> dp.FileDescriptorProto:
         field("inputs", 3, F.TYPE_MESSAGE, REP, "TensorProto"),
         field("requested_outputs", 4, F.TYPE_STRING, REP),
         field("correlation_id", 5, F.TYPE_UINT64),
+        # request-scoped trace/request id minted by the client (hex string);
+        # empty = untraced.  Spans on both sides tag themselves with it so
+        # client and server Chrome traces merge into one timeline.
+        field("trace_id", 6, F.TYPE_STRING),
     ])
 
     m = fd.message_type.add(name="InferResponse")
@@ -128,6 +132,8 @@ def build_file() -> dp.FileDescriptorProto:
         # remaining end-to-end budget in ms at send time (relative, so
         # replica clocks need not agree); 0 = no deadline
         field("deadline_ms", 12, F.TYPE_UINT64),
+        # request-scoped trace/request id (see InferRequest.trace_id)
+        field("trace_id", 13, F.TYPE_STRING),
     ])
     m.oneof_decl.add(name="_seed")
 
@@ -187,9 +193,12 @@ def main() -> int:
         " pb.GenerateRequest.DESCRIPTOR.fields]);"
         "print('StatusCode:', dict(pb.StatusCode.items()));"
         "r = pb.GenerateRequest(model_name='m', prompt=[1,2], steps=3,"
-        " deadline_ms=250);"
-        "assert pb.GenerateRequest.FromString(r.SerializeToString())"
-        ".deadline_ms == 250;"
+        " deadline_ms=250, trace_id='abc123');"
+        "r = pb.GenerateRequest.FromString(r.SerializeToString());"
+        "assert r.deadline_ms == 250 and r.trace_id == 'abc123';"
+        "ir = pb.InferRequest(model_name='m', trace_id='abc123');"
+        "assert pb.InferRequest.FromString(ir.SerializeToString())"
+        ".trace_id == 'abc123';"
         "r2 = pb.GenerateRequest();"
         "assert not r2.HasField('seed');"
         "r2.seed = 9; assert r2.HasField('seed');"

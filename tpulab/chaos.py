@@ -67,6 +67,12 @@ log = logging.getLogger("tpulab.chaos")
 #: every injection point pays in production
 _ARMED: Optional["FaultSchedule"] = None
 
+#: optional fire observer ``fn(point, action)`` — the metrics bridge
+#: (tpulab.utils.metrics.ChaosMetrics).  Called ONLY when a rule actually
+#: fires, outside the schedule lock, before the action executes (so even a
+#: ``kill`` is counted on its way out); never on the disarmed path.
+_OBSERVER = None
+
 _ACTIONS = ("error", "delay", "drop", "kill")
 
 #: exit code for the ``kill`` action — distinguishable from a real crash
@@ -179,6 +185,12 @@ class FaultSchedule:
                 break
         if action is None:
             return None
+        obs = _OBSERVER
+        if obs is not None:
+            try:
+                obs(point, action)
+            except Exception:  # pragma: no cover - observer must not
+                pass           # change injection behavior
         log.debug("chaos: %s at %s (value=%s)", action, point, value)
         if action == "delay":
             if value > 0:
@@ -211,6 +223,14 @@ def arm(schedule: Optional[FaultSchedule]) -> None:
 
 def armed() -> Optional[FaultSchedule]:
     return _ARMED
+
+
+def set_observer(fn) -> None:
+    """Install (or with ``None`` remove) the process-wide fire observer
+    ``fn(point, action)``.  One slot, cold path: tests/telemetry install a
+    ChaosMetrics bridge so fault-injection experiments are self-measuring."""
+    global _OBSERVER
+    _OBSERVER = fn
 
 
 class inject:

@@ -87,9 +87,14 @@ class GenerationEngine:
 
     # -- streaming sessions --------------------------------------------------
     def start_session(self, timeout: Optional[float] = None) -> "GenerationSession":
-        """Lease a cache slot; blocks when all sessions are busy."""
+        """Lease a cache slot; blocks when all sessions are busy.  The
+        blocking wait is recorded on the session (``lease_wait_s``) — the
+        dense engine's queue-wait, observable by serving telemetry."""
+        import time as _time
+        t0 = _time.perf_counter()
         item = self._sessions.pop(timeout)
-        return GenerationSession(self, item)
+        return GenerationSession(self, item,
+                                 lease_wait_s=_time.perf_counter() - t0)
 
     @property
     def available_sessions(self) -> int:
@@ -99,13 +104,16 @@ class GenerationEngine:
 class GenerationSession:
     """One leased KV-cache slot (close/GC returns it to the pool)."""
 
-    def __init__(self, engine: GenerationEngine, item: PoolItem):
+    def __init__(self, engine: GenerationEngine, item: PoolItem,
+                 lease_wait_s: float = 0.0):
         self._engine = engine
         self._item = item
         self._cache = item.get()
         self._pos = 0
         self._last_logits = None
         self._closed = False
+        #: seconds this lease blocked on the session pool (queue wait)
+        self.lease_wait_s = lease_wait_s
 
     @property
     def position(self) -> int:

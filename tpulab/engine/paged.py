@@ -636,12 +636,15 @@ class _PagedRequest:
     __slots__ = ("prompt", "steps", "future", "tokens_out", "pages",
                  "length", "pending_prompt", "on_token", "cancelled",
                  "sampling", "priority", "resumed", "admit_seq",
-                 "stop_tokens", "want_logprobs", "logprobs_out", "deadline")
+                 "stop_tokens", "want_logprobs", "logprobs_out", "deadline",
+                 "trace_id", "t_submit", "t_prefill0", "t_first", "t_last",
+                 "chunk_t0", "chunk_start")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
                  sampling: Optional[SamplingParams] = None,
                  priority: int = 0, stop_tokens=None,
-                 logprobs: bool = False, deadline: Optional[float] = None):
+                 logprobs: bool = False, deadline: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.steps = steps
         self.future: Future = Future()
@@ -663,6 +666,14 @@ class _PagedRequest:
         #: per-iteration sweep cancels expired requests before their next
         #: step, freeing the lane and pages
         self.deadline = deadline
+        # -- request-lifecycle telemetry (trace spans + latency metrics) ----
+        self.trace_id = trace_id
+        self.t_submit = _time.perf_counter()
+        self.t_prefill0: Optional[float] = None  # first prefill start
+        self.t_first: Optional[float] = None     # first emitted token
+        self.t_last: Optional[float] = None      # latest emitted token
+        self.chunk_t0: Optional[float] = None    # open decode-chunk start
+        self.chunk_start = 0                     # first token idx in chunk
 
     def finished(self) -> bool:
         """steps exhausted, or the last emitted token is a stop token
@@ -685,6 +696,10 @@ class ContinuousBatcher:
     #: explicit capability marker for routers (e.g. the Generate RPC)
     continuous_batching = True
 
+    #: decode tokens per trace span ("each decode chunk"): per-token spans
+    #: would swamp the bounded event ring at serving rates
+    TRACE_DECODE_CHUNK = 8
+
     #: shortest max_len at which use_kernel=None auto-selects the pallas
     #: kernel on TPU (below this the only live capture shows the XLA
     #: gather ahead; see __init__'s auto-select comment)
@@ -700,7 +715,8 @@ class ContinuousBatcher:
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
                  kv_dtype=None,
-                 prefill_flash: Optional[bool] = None):
+                 prefill_flash: Optional[bool] = None,
+                 trace=None, metrics=None):
         import jax
         import jax.numpy as jnp
 
@@ -790,6 +806,14 @@ class ContinuousBatcher:
             # writes from a page boundary)
             prefill_chunk -= prefill_chunk % page_size
         self.prefill_chunk = prefill_chunk
+        #: optional tpulab.utils.tracing.ChromeTraceRecorder — the batcher
+        #: records queue/prefill/decode-chunk spans per request (spans ride
+        #: per-lane rows; the serving layer may attach one post-hoc)
+        self.trace = trace
+        #: optional tpulab.utils.metrics.GenerationMetrics — TTFT /
+        #: inter-token / queue-wait / e2e distributions observed per
+        #: completed request at the source, not polled
+        self.metrics = metrics
         self._queue: List[_PagedRequest] = []
         self._requests: Dict[Future, _PagedRequest] = {}
         self._active: List[Optional[_PagedRequest]] = [None] * lanes
@@ -820,7 +844,8 @@ class ContinuousBatcher:
     def submit(self, prompt, steps: int, on_token=None,
                sampling: Optional[SamplingParams] = None,
                priority: int = 0, stop_tokens=None,
-               logprobs: bool = False, deadline=None) -> Future:
+               logprobs: bool = False, deadline=None,
+               trace_id: Optional[str] = None) -> Future:
         """``on_token(token, index)`` (optional) streams tokens as they
         decode — the hook the Generate RPC rides for paged serving.
         ``sampling`` selects the token policy (default greedy).
@@ -839,7 +864,10 @@ class ContinuousBatcher:
         ``deadline`` (a :class:`~tpulab.core.deadline.Deadline` or a float
         budget in seconds) bounds the request: the scheduler cancels it
         before its next step once expired — lane and KV pages free within
-        one tick — and the future fails with DeadlineExceeded."""
+        one tick — and the future fails with DeadlineExceeded.
+        ``trace_id`` tags this request's queue/prefill/decode spans in the
+        attached ``trace`` recorder (the Generate RPC threads the client's
+        id through here, merging both processes into one timeline)."""
         flat = np.asarray(prompt).reshape(-1)
         if isinstance(deadline, Deadline):
             deadline = deadline.expiry
@@ -859,7 +887,7 @@ class ContinuousBatcher:
         req = _PagedRequest(prompt, steps, on_token=on_token,
                             sampling=sampling, priority=priority,
                             stop_tokens=stop_tokens, logprobs=logprobs,
-                            deadline=deadline)
+                            deadline=deadline, trace_id=trace_id)
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("ContinuousBatcher is shut down")
@@ -899,6 +927,32 @@ class ContinuousBatcher:
     def queued_requests(self) -> int:
         with self._cv:
             return len(self._queue)
+
+    # -- telemetry (no-ops without an attached recorder/metrics) ------------
+    def _span(self, name: str, lane: int, t0: float, dur: float,
+              req: _PagedRequest, **extra) -> None:
+        """One request-lifecycle span on the lane's trace row."""
+        tr = self.trace
+        if tr is None:
+            return
+        if req.trace_id:
+            extra["trace_id"] = req.trace_id
+        tr.add_span(name, t0, dur, tid=lane, lane=lane, **extra)
+
+    def _flush_decode_chunk(self, req: _PagedRequest, lane: int,
+                            now: float) -> None:
+        """Close the open decode-chunk span at ``now`` and start the next."""
+        n = len(req.tokens_out)
+        if req.chunk_t0 is not None and n > req.chunk_start:
+            self._span("decode", lane, req.chunk_t0, now - req.chunk_t0,
+                       req, first=req.chunk_start,
+                       tokens=n - req.chunk_start)
+        req.chunk_t0 = now
+        req.chunk_start = n
+
+    def _note_complete(self, req: _PagedRequest) -> None:
+        if self.metrics is not None:
+            self.metrics.observe_e2e(_time.perf_counter() - req.t_submit)
 
     # -- scheduler ----------------------------------------------------------
     def _enqueue_locked(self, req: _PagedRequest,
@@ -1032,15 +1086,17 @@ class ContinuousBatcher:
                     req.future.cancel() or req.future.set_exception(
                         RuntimeError("generation cancelled"))
             for req in expired:
+                if self.metrics is not None:
+                    self.metrics.note_deadline_expired()
                 if not req.future.done():
                     req.future.set_exception(DeadlineExceeded(
                         "generation deadline exceeded "
                         f"({len(req.tokens_out)}/{req.steps} tokens)"))
             try:
                 prefilled = False
-                for req in snapshot:
+                for lane, req in enumerate(snapshot):
                     if req is not None and req.pending_prompt:
-                        prefilled |= self._do_prefill(req, jnp)
+                        prefilled |= self._do_prefill(req, jnp, lane)
                 if prefilled:
                     # a steps==1 request can complete at prefill
                     done_reqs = []
@@ -1056,6 +1112,7 @@ class ContinuousBatcher:
                         if not req.future.done():
                             req.future.set_result(self._result_of(req))
                             self.completed_requests += 1
+                            self._note_complete(req)
                 progressed = self._tick(snapshot, jnp) or prefilled
                 if not progressed:
                     # every lane starved (pool pressure): back off instead
@@ -1075,7 +1132,7 @@ class ContinuousBatcher:
                     self.prefix_cache.drop_all()  # entries died with the pool
                 self.pool.reset()
 
-    def _do_prefill(self, req: _PagedRequest, jnp) -> bool:
+    def _do_prefill(self, req: _PagedRequest, jnp, lane: int = 0) -> bool:
         """Fused prompt prefill: one compiled forward (per length bucket)
         fills the whole prompt's KV pages.  With a prefix cache, shared
         full-page prefixes are reused and only the tail runs (paged_extend);
@@ -1108,6 +1165,15 @@ class ContinuousBatcher:
         tables = np.zeros((self.max_pages,), np.int32)
         tables[:len(req.pages)] = req.pages
         tables_j = jnp.asarray(tables)
+        # pages secured: the queue wait ends HERE (first prefill only — a
+        # preemption resume re-prefills but already left the queue once)
+        t_pf0 = _time.perf_counter()
+        if req.t_prefill0 is None:
+            req.t_prefill0 = t_pf0
+            self._span("queue_wait", lane, req.t_submit,
+                       t_pf0 - req.t_submit, req)
+            if self.metrics is not None:
+                self.metrics.observe_queue_wait(t_pf0 - req.t_submit)
         # chaos: prefill fault site — an error here rides the scheduler's
         # recovery path (fail actives + pool reset), a delay is a slow
         # prefill under deadline pressure
@@ -1194,6 +1260,19 @@ class ContinuousBatcher:
                     _j.asarray(last_logits, _j.float32))[tok]))
                 req.logprobs_out.append(lp)
             self._emit(req, tok, 0, lp)
+        # prefill span closes after the first-token pick (the pick's logits
+        # fetch is the fence that makes the device time real); decode
+        # chunks start from here
+        t_pf1 = _time.perf_counter()
+        self._span("prefill", lane, t_pf0, t_pf1 - t_pf0, req,
+                   prompt_tokens=t, cached_pages=len(shared))
+        req.chunk_t0 = t_pf1
+        req.chunk_start = len(req.tokens_out)
+        if not was_resumed:
+            req.t_first = t_pf1
+            req.t_last = t_pf1
+            if self.metrics is not None:
+                self.metrics.observe_ttft(t_pf1 - req.t_submit)
         if self.prefix_cache is not None and not was_resumed:
             # count each logical request once (resume prefills re-walk
             # already-counted pages) and publish only first-prefill pages:
@@ -1310,6 +1389,8 @@ class ContinuousBatcher:
 
         emits: List = []
         completed: List = []
+        now = _time.perf_counter()  # post-fetch: the tick's device work is
+        #                             done, so per-lane deltas are real
         with self._cv:
             for lane, req in enumerate(snapshot):
                 if req is None:
@@ -1321,13 +1402,20 @@ class ContinuousBatcher:
                 req.length += 1
                 req.tokens_out.append(int(next_tokens[lane]))
                 self.tokens_generated += 1
+                if self.metrics is not None and req.t_last is not None:
+                    self.metrics.observe_itl(now - req.t_last)
+                req.t_last = now
                 lp = (float(logprobs_arr[lane])
                       if logprobs_arr is not None else None)
                 if req.want_logprobs:
                     req.logprobs_out.append(lp)
                 emits.append((req, req.tokens_out[-1],
                               len(req.tokens_out) - 1, lp))
-                if req.finished():
+                done = req.finished()
+                if (done or len(req.tokens_out) - req.chunk_start
+                        >= self.TRACE_DECODE_CHUNK):
+                    self._flush_decode_chunk(req, lane, now)
+                if done:
                     self._release_lane_locked(lane, req)
                     completed.append(req)
             self._admit_locked()
@@ -1339,6 +1427,7 @@ class ContinuousBatcher:
             if not req.future.done():
                 req.future.set_result(self._result_of(req))
                 self.completed_requests += 1
+                self._note_complete(req)
         return True
 
     @staticmethod

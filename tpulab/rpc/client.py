@@ -69,9 +69,12 @@ class ClientUnary:
             response_deserializer=self._des)
 
     def start(self, request, on_complete: Optional[Callable] = None,
-              timeout: Optional[float] = None) -> Future:
+              timeout: Optional[float] = None,
+              metadata: Optional[list] = None) -> Future:
         """Async call; returns a future of on_complete(response) (identity
-        by default).  Mirrors async_compute-wrapped completions."""
+        by default).  Mirrors async_compute-wrapped completions.
+        ``metadata`` rides the call as gRPC invocation metadata (e.g. the
+        trace context, utils.tracing.TRACE_METADATA_KEY)."""
         task = SharedPackagedTask(on_complete or (lambda resp: resp))
         # chaos: delay/error the send, or black-hole it entirely — the
         # future then resolves only via its own timeout, exactly what a
@@ -88,7 +91,8 @@ class ClientUnary:
                 t.daemon = True
                 t.start()
             return fut
-        call = self._stub().future(request, timeout=timeout)
+        call = self._stub().future(request, timeout=timeout,
+                                   metadata=metadata)
 
         def _done(c):
             try:
@@ -112,10 +116,12 @@ class ClientStreaming:
                  on_response: Callable[[Any], None],
                  request_serializer: Callable[[Any], bytes] = None,
                  response_deserializer: Callable[[bytes], Any] = None,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 metadata: Optional[list] = None):
         """``timeout`` sets the gRPC deadline for the WHOLE stream: the
         transport-level backstop of the application deadline (the server
-        sees it via ``grpc-timeout`` metadata / ``time_remaining()``)."""
+        sees it via ``grpc-timeout`` metadata / ``time_remaining()``);
+        ``metadata`` rides as invocation metadata (trace context)."""
         self._on_response = on_response
         self._writes: "_queue.Queue" = _queue.Queue()
         self._done: Future = Future()
@@ -130,7 +136,8 @@ class ClientStreaming:
                     return
                 yield item
 
-        self._call = stub(request_iter(), timeout=timeout)
+        self._call = stub(request_iter(), timeout=timeout,
+                          metadata=metadata)
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 

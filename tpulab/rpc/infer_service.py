@@ -23,11 +23,17 @@ from tpulab.rpc.context import Context, StreamingContext
 from tpulab.rpc.executor import Executor
 from tpulab.rpc.protos import inference_pb2 as pb
 from tpulab.rpc.server import AsyncService, Server
+from tpulab.utils.tracing import TraceContext
 
 log = logging.getLogger("tpulab.rpc")
 
 SERVICE_NAME = "tpulab.inference.GRPCService"
 SERVER_VERSION = "tpulab-0.1"
+
+#: decode tokens per trace span — the "each decode chunk" granularity of
+#: the request timeline (per-token spans would swamp the event ring at
+#: serving rates; 8-token chunks keep tail structure visible)
+TRACE_DECODE_CHUNK = 8
 
 
 # -- tensor <-> proto ---------------------------------------------------------
@@ -255,16 +261,19 @@ class InferContext(Context):
                 respond=t2 - t1)
             if res.trace is not None:
                 # per-request lifecycle spans on this worker thread's row
-                # (chrome://tracing / perfetto)
-                res.trace.add_span("batch_wait", t0, queue_s,
-                                   model=request.model_name)
+                # (chrome://tracing / perfetto), tagged with the client's
+                # trace id when one rode in (request field or metadata) so
+                # they merge with the client's attempt spans
+                targs = {"model": request.model_name}
+                tc = TraceContext.of_request(request, self.grpc_context)
+                if tc is not None:
+                    targs["trace_id"] = tc.trace_id
+                res.trace.add_span("batch_wait", t0, queue_s, **targs)
                 res.trace.add_span("pipeline", t0 + queue_s,
                                    (t1 - t0) - queue_s,
-                                   model=request.model_name,
                                    compute_ms=round(1e3 * (compute_s or 0),
-                                                    3))
-                res.trace.add_span("respond", t1, t2 - t1,
-                                   model=request.model_name)
+                                                    3), **targs)
+                res.trace.add_span("respond", t1, t2 - t1, **targs)
         except Exception as e:  # noqa: BLE001
             log.exception("inference failed")
             resp.status.code = pb.INTERNAL
@@ -513,10 +522,27 @@ class GenerateContext(StreamingContext):
                         "backend")))
             return
         deadline = self._deadline_of(request)
+        # trace: queue(lease wait)/prefill/decode-chunk spans on this
+        # worker's row, tagged with the client's trace id (merged-timeline
+        # contract, docs/OBSERVABILITY.md).  All span bookkeeping is gated
+        # on the recorder so the untraced path pays two None checks.
+        import time as _time
+        trace = res.trace
+        targs = {"model": request.model_name}
+        tc = TraceContext.of_request(request, self.grpc_context)
+        if tc is not None:
+            targs["trace_id"] = tc.trace_id
+
+        def span(name, t0, dur, **extra):
+            if trace is not None:
+                trace.add_span(name, t0, dur, **targs, **extra)
         try:
             stops = set(request.stop_tokens)
+            t_lease0 = _time.perf_counter()
             with engine.start_session(
                     timeout=self.SESSION_LEASE_TIMEOUT_S) as session:
+                t_lease1 = _time.perf_counter()
+                span("queue_wait", t_lease0, t_lease1 - t_lease0)
                 try:
                     # PRE-STREAM validation only (ADVICE r5): engines
                     # validate prompt bounds/lengths eagerly at prefill/
@@ -526,18 +552,33 @@ class GenerateContext(StreamingContext):
                     # A ValueError raised LATER, mid-iteration, is an
                     # internal fault and falls through to INTERNAL
                     # (retryable) below.
+                    t0 = _time.perf_counter()
                     session.prefill(np.asarray(request.prompt, np.int32))
                     stream = session.stream(request.steps)
+                    span("prefill", t0, _time.perf_counter() - t0,
+                         prompt_tokens=len(request.prompt))
                 except ValueError as e:
                     self.write(pb.GenerateResponse(
                         final=True, status=pb.RequestStatus(
                             code=pb.INVALID_ARGUMENT, message=str(e))))
                     return
+                chunk_t0 = _time.perf_counter()
+                chunk_start = 0
+
+                def flush_chunk(end):  # span per TRACE_DECODE_CHUNK tokens
+                    nonlocal chunk_t0, chunk_start
+                    if end > chunk_start:
+                        span("decode", chunk_t0,
+                             _time.perf_counter() - chunk_t0,
+                             first=chunk_start, tokens=end - chunk_start)
+                    chunk_t0 = _time.perf_counter()
+                    chunk_start = end
                 for i, tok in enumerate(stream):
                     if deadline is not None and deadline.expired():
                         # cancelled before the next token step; leaving the
                         # with-block frees the session slot NOW
                         log.info("generation deadline exceeded at step %d", i)
+                        flush_chunk(i)
                         self.write(pb.GenerateResponse(
                             final=True, status=pb.RequestStatus(
                                 code=pb.DEADLINE_EXCEEDED,
@@ -547,15 +588,23 @@ class GenerateContext(StreamingContext):
                             and hasattr(self.grpc_context, "is_active")
                             and not self.grpc_context.is_active()):
                         log.info("generation cancelled by client at step %d", i)
+                        flush_chunk(i)
                         return  # free the session slot immediately
                     # chaos: per-token server fault site (error = transient
                     # stream failure; kill = replica process death)
                     chaos.trip("rpc.server.generate_token")
                     self.write(pb.GenerateResponse(token=tok, index=i))
+                    if (i + 1) % TRACE_DECODE_CHUNK == 0:
+                        flush_chunk(i + 1)
                     if tok in stops:
+                        flush_chunk(i + 1)
                         break  # stop token emitted; end like the paged path
+                else:
+                    flush_chunk(request.steps)
+            t0 = _time.perf_counter()
             self.write(pb.GenerateResponse(
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
+            span("respond", t0, _time.perf_counter() - t0)
         except DeadlineExceeded as e:
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
                 code=pb.DEADLINE_EXCEEDED, message=str(e))))
@@ -580,7 +629,15 @@ class GenerateContext(StreamingContext):
                     logprob=0.0 if logprob is None else float(logprob)))
 
         fut = None
+        res = self.get_resources(InferResources)
         deadline = self._deadline_of(request)
+        if (res.trace is not None and getattr(engine, "trace", None) is None
+                and hasattr(engine, "trace")):
+            # adopt the service's recorder once: the batcher then records
+            # its own queue/prefill/decode-chunk spans at the source
+            # (scheduler thread), where the RPC layer can't see them
+            engine.trace = res.trace
+        tc = TraceContext.of_request(request, self.grpc_context)
         try:
             sampling = None
             if request.temperature > 0.0:
@@ -596,6 +653,9 @@ class GenerateContext(StreamingContext):
                 # before the next step); only passed when present so
                 # wrapped/test engines without the kwarg keep working
                 kw["deadline"] = deadline
+            if tc is not None:
+                # same gating: only traced requests carry the kwarg
+                kw["trace_id"] = tc.trace_id
             fut = engine.submit(np.asarray(request.prompt, np.int32),
                                 request.steps, on_token=on_token,
                                 sampling=sampling,
@@ -676,7 +736,8 @@ class GenerateStreamClient:
                  top_k: int = 0, seed: Optional[int] = None,
                  stop_tokens=(), device_sampling: bool = False,
                  return_logprobs: bool = False, top_p: float = 0.0,
-                 deadline_s: Optional[float] = None):
+                 deadline_s: Optional[float] = None,
+                 trace_id: Optional[str] = None):
         """Yields token ids; with ``return_logprobs=True`` yields
         ``(token, logprob)`` pairs instead.
 
@@ -686,7 +747,9 @@ class GenerateStreamClient:
         carries it as the transport deadline (backstop), and expiry here
         raises :class:`~tpulab.core.deadline.DeadlineExceeded`.
         ``timeout`` remains the per-activity stall bound (no stream
-        progress for that long = the replica is stuck)."""
+        progress for that long = the replica is stuck).  ``trace_id``
+        (utils.tracing) rides the request AND the gRPC metadata so server
+        spans join the client's trace timeline."""
         import queue as _q
         deadline = Deadline.after(deadline_s)
         out: "_q.Queue" = _q.Queue()
@@ -700,7 +763,9 @@ class GenerateStreamClient:
             self._manager._executor, f"/{SERVICE_NAME}/Generate", out.put,
             pb.GenerateRequest.SerializeToString,
             pb.GenerateResponse.FromString,
-            timeout=None if rem0 is None else rem0 + 2.0)
+            timeout=None if rem0 is None else rem0 + 2.0,
+            metadata=(list(TraceContext(trace_id).metadata())
+                      if trace_id else None))
         # a dead stream must wake the consumer promptly, not via timeout
         _STREAM_DEAD = object()
         stream.done().add_done_callback(lambda _f: out.put(_STREAM_DEAD))
@@ -712,6 +777,8 @@ class GenerateStreamClient:
             stop_tokens=[int(t) for t in stop_tokens],
             device_sampling=device_sampling,
             return_logprobs=return_logprobs)
+        if trace_id:
+            req.trace_id = trace_id
         if seed is not None:
             req.seed = seed
         rem = deadline.remaining()
@@ -890,7 +957,7 @@ class InferRemoteRunner:
         return {s.name: (tuple(s.dims), np.dtype(s.dtype))
                 for s in self.status.outputs}
 
-    def infer(self, requested_outputs=None, timeout=None,
+    def infer(self, requested_outputs=None, timeout=None, trace_id=None,
               **arrays: np.ndarray):
         """Future of dict-of-numpy outputs.
 
@@ -898,8 +965,11 @@ class InferRemoteRunner:
         outputs; unknown names fail the request with INVALID_ARGUMENT.
         ``timeout`` (seconds) becomes the call's gRPC deadline — the
         per-attempt budget replica routers derive from an end-to-end
-        deadline.  Model inputs literally named ``requested_outputs`` or
-        ``timeout`` still work: ndarray values are rebound as inputs.
+        deadline.  ``trace_id`` (utils.tracing) rides the request and the
+        gRPC metadata so the server's lifecycle spans join the client's
+        trace.  Model inputs literally named ``requested_outputs``,
+        ``timeout`` or ``trace_id`` still work: ndarray values are rebound
+        as inputs.
         """
         if isinstance(requested_outputs, np.ndarray):
             arrays["requested_outputs"] = requested_outputs
@@ -907,10 +977,15 @@ class InferRemoteRunner:
         if isinstance(timeout, np.ndarray):
             arrays["timeout"] = timeout
             timeout = None
+        if isinstance(trace_id, np.ndarray):
+            arrays["trace_id"] = trace_id
+            trace_id = None
         if not arrays:
             raise ValueError("no input arrays")
         batch = next(iter(arrays.values())).shape[0]
         req = pb.InferRequest(model_name=self.model_name, batch_size=batch)
+        if trace_id:
+            req.trace_id = trace_id
         if requested_outputs:
             req.requested_outputs.extend(requested_outputs)
         for name, arr in arrays.items():
@@ -923,4 +998,7 @@ class InferRemoteRunner:
                     f"{resp.status.message}")
             return {t.name: proto_to_tensor(t) for t in resp.outputs}
 
-        return self._mgr._infer.start(req, on_complete, timeout=timeout)
+        return self._mgr._infer.start(
+            req, on_complete, timeout=timeout,
+            metadata=(list(TraceContext(trace_id).metadata())
+                      if trace_id else None))

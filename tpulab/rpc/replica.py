@@ -58,8 +58,33 @@ from typing import Dict, List, Optional, Sequence
 from tpulab.core.deadline import Deadline, DeadlineExceeded
 from tpulab.rpc.infer_service import (GenerateStreamClient,
                                       RemoteInferenceManager)
+from tpulab.utils.tracing import mint_trace_id
 
 log = logging.getLogger("tpulab.rpc")
+
+
+def _status_code_of(exc: Optional[BaseException]) -> str:
+    """Attempt-outcome label for the per-attempt counter: the gRPC status
+    code name when the transport provides one, the protocol status for
+    server-side rejections, the framework's own classes otherwise."""
+    if exc is None:
+        return "OK"
+    if isinstance(exc, DeadlineExceeded):
+        return "DEADLINE_EXCEEDED"
+    from tpulab.rpc.infer_service import GenerationRejected
+    if isinstance(exc, GenerationRejected):
+        from tpulab.rpc.protos import inference_pb2 as pb
+        try:
+            return pb.StatusCode.Name(exc.code)
+        except ValueError:
+            return f"CODE_{exc.code}"
+    import grpc
+    if isinstance(exc, grpc.RpcError):
+        try:
+            return exc.code().name
+        except Exception:  # noqa: BLE001 - exotic RpcError shims
+            return "RPC_ERROR"
+    return type(exc).__name__
 
 
 class _BaseReplicaSet:
@@ -72,7 +97,7 @@ class _BaseReplicaSet:
                  metrics=None, breaker_threshold: int = 3,
                  probe_backoff_s: float = 0.25,
                  probe_backoff_cap_s: float = 30.0,
-                 probe_timeout_s: float = 5.0):
+                 probe_timeout_s: float = 5.0, trace=None):
         if not addresses:
             raise ValueError("need at least one replica address")
         self.addresses = list(addresses)
@@ -105,6 +130,10 @@ class _BaseReplicaSet:
         self.ejections = 0
         #: optional :class:`tpulab.utils.metrics.ReplicaSetMetrics`
         self._metrics = metrics
+        #: optional :class:`tpulab.utils.tracing.ChromeTraceRecorder` —
+        #: per-attempt client spans (trace id + attempt + replica), the
+        #: client half of the merged request timeline
+        self.trace = trace
         if metrics is not None:
             # label children resolved ONCE: .labels() takes the metric's
             # lock + hashes the tuple, too heavy for inside the routing
@@ -115,6 +144,9 @@ class _BaseReplicaSet:
                                 for a in self.addresses]
             # live children are NOT pre-created: a gauge child is born at
             # 0, and "0 = dead" must only ever come from a real probe
+            if hasattr(metrics, "set_breaker_state"):
+                for a in self.addresses:  # every breaker starts closed
+                    metrics.set_breaker_state(a, "closed")
 
     # -- metrics hooks (no-ops without a metrics object) --------------------
     def _note_inflight(self, idx: int) -> None:
@@ -129,6 +161,42 @@ class _BaseReplicaSet:
     def _note_failover(self) -> None:
         if self._metrics is not None:
             self._metrics.failovers.inc()
+
+    def _note_breaker(self, idx: int, to_state: str) -> None:
+        """Breaker state change (cold path: ejection/probe/restore)."""
+        m = self._metrics
+        if m is not None and hasattr(m, "note_breaker_transition"):
+            m.note_breaker_transition(self.addresses[idx], to_state)
+
+    def _note_attempt(self, exc: Optional[BaseException]) -> None:
+        """Per-attempt terminal status, keyed the way retry policies are
+        tuned: gRPC status code name when the transport says, else the
+        framework's own classification."""
+        m = self._metrics
+        if m is not None and hasattr(m, "note_attempt"):
+            m.note_attempt(_status_code_of(exc))
+
+    def _note_deadline(self, met: bool, deadline: Deadline) -> None:
+        """Outcome of a deadline-BOUNDED request (unbounded ones don't
+        report: 'met' would be vacuous)."""
+        m = self._metrics
+        if (m is not None and hasattr(m, "observe_deadline")
+                and deadline.expiry is not None):
+            m.observe_deadline(met, deadline.remaining())
+
+    def _attempt_span(self, start_s: float, idx: int, attempt: int,
+                      trace_id: Optional[str],
+                      exc: Optional[BaseException]) -> None:
+        """One client-side attempt span (tagged attempt + replica + code)."""
+        tr = self.trace
+        if tr is None:
+            return
+        import time as _t
+        args = {"replica": self.addresses[idx], "attempt": attempt,
+                "code": _status_code_of(exc)}
+        if trace_id:
+            args["trace_id"] = trace_id
+        tr.add_span("attempt", start_s, _t.perf_counter() - start_s, **args)
 
     # -- circuit breaker ----------------------------------------------------
     def breaker_states(self) -> Dict[str, str]:
@@ -170,6 +238,7 @@ class _BaseReplicaSet:
             log.warning("replica %s ejected after %d consecutive failures; "
                         "background probe armed", self.addresses[idx],
                         self._cb_threshold)
+            self._note_breaker(idx, "open")
             self._ensure_probe_thread()
             self._probe_wake.set()
 
@@ -180,6 +249,7 @@ class _BaseReplicaSet:
         self._fail_streak[idx] = 0
         self._probe_next.pop(idx, None)
         self._probe_interval.pop(idx, None)
+        self._note_breaker(idx, "closed")
         log.info("replica %s restored to rotation (%s)",
                  self.addresses[idx], how)
 
@@ -221,6 +291,7 @@ class _BaseReplicaSet:
                     if idx not in self._open:
                         continue
                     self._probing.add(idx)
+                self._note_breaker(idx, "probing")
                 ok = False
                 try:
                     resp = self._managers[idx].health_async().result(
@@ -240,6 +311,7 @@ class _BaseReplicaSet:
                             self._probe_backoff_cap_s)
                         self._probe_interval[idx] = iv
                         self._probe_next[idx] = time.monotonic() + iv
+                        self._note_breaker(idx, "open")  # probe failed
 
     # -- health -------------------------------------------------------------
     def health(self, timeout: float = 10.0) -> Dict[str, dict]:
@@ -376,61 +448,75 @@ class ReplicaSet(_BaseReplicaSet):
             arrays["deadline_s"] = deadline_s
             deadline_s = None
         outer: Future = Future()
+        # one trace id per LOGICAL request (attempts share it: failover
+        # replays line up under one id in the merged timeline)
         self._submit(outer, arrays, attempts_left=self._max_failover,
-                     exclude=frozenset(), deadline=Deadline.after(deadline_s))
+                     exclude=frozenset(), deadline=Deadline.after(deadline_s),
+                     trace_id=mint_trace_id())
         return outer
 
+    def _deadline_failed(self, outer: Future, deadline: Deadline) -> None:
+        self._note_deadline(False, deadline)
+        if not outer.done():
+            outer.set_exception(
+                DeadlineExceeded("inference deadline exceeded"))
+
     def _submit(self, outer: Future, arrays: dict, attempts_left: int,
-                exclude: frozenset, deadline: Deadline) -> None:
+                exclude: frozenset, deadline: Deadline,
+                trace_id: Optional[str] = None) -> None:
         if deadline.expired():
-            if not outer.done():
-                outer.set_exception(
-                    DeadlineExceeded("inference deadline exceeded"))
+            self._deadline_failed(outer, deadline)
             return
         idx = self._pick_or_any(exclude)
         if idx is None:  # unreachable: >=1 replica by construction
             outer.set_exception(RuntimeError("no replicas"))
             return
+        attempt = self._max_failover - attempts_left
+        t_att = time.perf_counter()
 
         def on_done(fut: Future) -> None:
             with self._lock:
                 self._inflight[idx] -= 1
                 self._note_inflight(idx)
             exc = fut.exception()
+            self._note_attempt(exc)
+            self._attempt_span(t_att, idx, attempt, trace_id, exc)
             if exc is None:
                 self._record_success(idx)
                 with self._lock:
                     self.served[idx] += 1
                 self._note_served(idx)
+                self._note_deadline(True, deadline)
                 if not outer.done():
                     outer.set_result(fut.result())
                 return
             self._record_failure(idx)
             if deadline.expired():
-                if not outer.done():
-                    outer.set_exception(
-                        DeadlineExceeded("inference deadline exceeded"))
+                self._deadline_failed(outer, deadline)
             elif attempts_left > 1 and not outer.done():
                 self._note_failover()
                 self._submit(outer, arrays, attempts_left - 1,
-                             exclude | {idx}, deadline)
+                             exclude | {idx}, deadline, trace_id)
             elif not outer.done():
                 outer.set_exception(exc)
 
         try:
             budget = deadline.per_attempt(attempts_left)
             self._runner(idx, timeout=budget).infer(
-                timeout=budget, **arrays).add_done_callback(on_done)
+                timeout=budget, trace_id=trace_id,
+                **arrays).add_done_callback(on_done)
         except Exception as e:  # submission itself failed (dead channel
             #                     or unreachable at first contact)
             with self._lock:
                 self._inflight[idx] -= 1
                 self._note_inflight(idx)
+            self._note_attempt(e)
+            self._attempt_span(t_att, idx, attempt, trace_id, e)
             self._record_failure(idx)
             if attempts_left > 1 and not deadline.expired():
                 self._note_failover()
                 self._submit(outer, arrays, attempts_left - 1,
-                             exclude | {idx}, deadline)
+                             exclude | {idx}, deadline, trace_id)
             else:
                 outer.set_exception(e)
 
@@ -505,6 +591,10 @@ class GenerationReplicaSet(_BaseReplicaSet):
         before its next token step) and expiry raises
         :class:`DeadlineExceeded` — never failed over, the budget is
         global.  ``timeout`` stays the per-activity stall bound.
+
+        ``trace_id`` (optional) joins this request to an existing trace;
+        by default one is minted per request — all failover attempts and
+        the server-side spans they produce share it (utils.tracing).
         """
         import numpy as np
         if kw.get("temperature", 0.0) and kw.get("seed") is None:
@@ -520,8 +610,14 @@ class GenerationReplicaSet(_BaseReplicaSet):
         delivered = 0
         attempts_left = self._max_failover
         exclude: set = set()
+        # one trace id for the logical request: every replay attempt (and
+        # the server spans it produces) shares it in the merged timeline
+        trace_id = kw.pop("trace_id", None) or mint_trace_id()
+        attempt = 0
         while True:
-            deadline.check("generation")
+            if deadline.expired():
+                self._note_deadline(False, deadline)
+                raise DeadlineExceeded("generation deadline exceeded")
             if self.prefix_affinity:
                 idx = self._pick_affine(prompt, frozenset(exclude))
             else:
@@ -529,13 +625,15 @@ class GenerationReplicaSet(_BaseReplicaSet):
             if idx is None:
                 raise RuntimeError("no replicas")
             gen = None
+            t_att = time.perf_counter()
             try:
                 akw = dict(kw)
                 rem = deadline.remaining()
                 if rem is not None:
                     akw["deadline_s"] = rem  # per-attempt = what's left
                 gen = self._clients[idx].generate(
-                    prompt, steps, timeout=deadline.bound(timeout), **akw)
+                    prompt, steps, timeout=deadline.bound(timeout),
+                    trace_id=trace_id, **akw)
                 i = 0
                 for item in gen:
                     if i >= delivered:  # replay skips what the consumer has
@@ -546,8 +644,13 @@ class GenerationReplicaSet(_BaseReplicaSet):
                     self.served[idx] += 1
                 self._record_success(idx)
                 self._note_served(idx)
+                self._note_attempt(None)
+                self._attempt_span(t_att, idx, attempt, trace_id, None)
+                self._note_deadline(True, deadline)
                 return
             except Exception as e:
+                self._note_attempt(e)
+                self._attempt_span(t_att, idx, attempt, trace_id, e)
                 from tpulab.rpc.infer_service import GenerationRejected
                 if isinstance(e, GenerationRejected) and not e.retryable:
                     # the server processed and rejected the request —
@@ -556,10 +659,12 @@ class GenerationReplicaSet(_BaseReplicaSet):
                     self._record_success(idx)
                     raise
                 if isinstance(e, DeadlineExceeded):
+                    self._note_deadline(False, deadline)
                     raise  # global budget spent: no replica can beat it
                 self._record_failure(idx)
                 attempts_left -= 1
                 exclude.add(idx)
+                attempt += 1
                 if attempts_left <= 0:
                     raise
                 self._note_failover()

@@ -13,7 +13,7 @@ server control lambda via :meth:`InferenceMetrics.poll_device`).
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
@@ -28,6 +28,21 @@ except ImportError:  # pragma: no cover
 LOAD_RATIO_BUCKETS = (1.25, 1.5, 2.0, 10.0, 100.0)
 
 _QUANTILES = (0.5, 0.9, 0.99)
+
+#: latency-distribution buckets (seconds).  TTFT/queue cover the serving
+#: SLO range (1 ms .. 10 s); ITL is finer (decode ticks are sub-10ms on
+#: chip); e2e stretches to streaming-request lifetimes.
+TTFT_BUCKETS = (.001, .0025, .005, .01, .025, .05, .1, .25, .5, 1., 2.5,
+                5., 10.)
+ITL_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5, 1.)
+E2E_BUCKETS = (.01, .025, .05, .1, .25, .5, 1., 2.5, 5., 10., 30., 60.)
+#: deadline slack-at-completion: how close completed requests run to their
+#: budget (small slack = the deadline is doing work; see OBSERVABILITY.md)
+SLACK_BUCKETS = (.001, .005, .01, .025, .05, .1, .25, .5, 1., 2.5, 5.,
+                 10., 30.)
+
+#: circuit-breaker states exported per replica (rpc/replica.py)
+BREAKER_STATES = ("closed", "open", "probing")
 
 
 class _Reservoir:
@@ -92,6 +107,12 @@ class InferenceMetrics:
         self.queue_depth = Gauge(
             f"{ns}_queue_depth", "In-flight requests (NVRPC_METRICS hook)",
             registry=self.registry)
+        # quantile refresh cadence state: counter + lock live here (not
+        # lazily in observe_request) so two racing observers cannot both
+        # read a stale count and both skip the refresh
+        self._since_refresh = 0
+        self._ever_refreshed = False
+        self._refresh_lock = threading.Lock()
 
     # -- observation hooks ---------------------------------------------------
     _REFRESH_EVERY = 64  # quantile refresh cadence (full reservoir sort)
@@ -105,13 +126,23 @@ class InferenceMetrics:
         if compute_s > 0:
             self.load_ratio.observe(request_s / compute_s)
         # quantile gauges refresh periodically (and from the control lambda),
-        # not per request — the sort is too heavy for the hot path
-        self._since_refresh = getattr(self, "_since_refresh", 0) + 1
-        if self._since_refresh == 1 or self._since_refresh >= self._REFRESH_EVERY:
+        # not per request — the sort is too heavy for the hot path.  The
+        # count-and-decide is atomic under the lock, so exactly one of N
+        # racing observers crosses the threshold and pays the sort (the
+        # pre-fix getattr dance let two skip it — or double-sort).  The
+        # very first observation refreshes immediately (scrape freshness);
+        # after that the cadence is every ``_REFRESH_EVERY``.
+        with self._refresh_lock:
+            self._since_refresh += 1
+            do_refresh = (not self._ever_refreshed
+                          or self._since_refresh >= self._REFRESH_EVERY)
+        if do_refresh:
             self.refresh_quantiles()
 
     def refresh_quantiles(self) -> None:
-        self._since_refresh = 0
+        with self._refresh_lock:
+            self._since_refresh = 0
+            self._ever_refreshed = True
         for q in _QUANTILES:
             self.request_quantiles.labels(quantile=str(q)).set(
                 self._request.quantile(q))
@@ -137,9 +168,12 @@ class InferenceMetrics:
 
 class ReplicaSetMetrics:
     """Observability for client-side replica routing
-    (:mod:`tpulab.rpc.replica`): per-replica traffic/inflight/liveness and
-    the failover counter — the client-side view envoy's upstream stats
-    give in deployment."""
+    (:mod:`tpulab.rpc.replica`): per-replica traffic/inflight/liveness,
+    the failover counter, circuit-breaker state/transitions, per-attempt
+    status-code counters and end-to-end deadline outcomes — the
+    client-side view envoy's upstream stats give in deployment, plus the
+    resilience telemetry the adaptive-orchestration line in PAPERS.md
+    argues breakers/deadlines need in order to be tunable."""
 
     def __init__(self, namespace: str = "tpulab",
                  registry: Optional["CollectorRegistry"] = None):
@@ -162,13 +196,69 @@ class ReplicaSetMetrics:
             f"{ns}_replica_live",
             "Last health-probe liveness per replica (1/0)", ["replica"],
             registry=self.registry)
+        # -- circuit breaker (one-hot state + transition counters) ----------
+        self.breaker_state = Gauge(
+            f"{ns}_replica_breaker_state",
+            "Circuit-breaker state per replica (one-hot over "
+            "closed/open/probing)", ["replica", "state"],
+            registry=self.registry)
+        self.breaker_transitions = Counter(
+            f"{ns}_replica_breaker_transitions_total",
+            "Breaker transitions per replica, keyed by target state",
+            ["replica", "to"], registry=self.registry)
+        # -- per-attempt outcomes (retry/failover tuning input) -------------
+        self.attempts = Counter(
+            f"{ns}_replica_attempts_total",
+            "Request attempts by terminal status code (OK, UNAVAILABLE, "
+            "DEADLINE_EXCEEDED, INVALID_ARGUMENT, ...)", ["code"],
+            registry=self.registry)
+        # -- end-to-end deadline outcomes -----------------------------------
+        self.deadline_outcomes = Counter(
+            f"{ns}_deadline_outcomes_total",
+            "Deadline-bounded requests by outcome (met/exceeded)",
+            ["outcome"], registry=self.registry)
+        self.deadline_slack = Histogram(
+            f"{ns}_deadline_slack_seconds",
+            "Remaining budget at completion of deadline-bounded requests",
+            buckets=SLACK_BUCKETS, registry=self.registry)
+
+    # -- hooks (called by the replica sets; cold paths) ---------------------
+    def set_breaker_state(self, replica: str, state: str) -> None:
+        """One-hot the per-replica state gauge (PromQL reads
+        ``..._breaker_state{state="open"} == 1``)."""
+        for s in BREAKER_STATES:
+            self.breaker_state.labels(replica=replica, state=s).set(
+                1 if s == state else 0)
+
+    def note_breaker_transition(self, replica: str, to_state: str) -> None:
+        self.breaker_transitions.labels(replica=replica, to=to_state).inc()
+        self.set_breaker_state(replica, to_state)
+
+    def note_attempt(self, code: str) -> None:
+        self.attempts.labels(code=code).inc()
+
+    def observe_deadline(self, met: bool,
+                         slack_s: Optional[float] = None) -> None:
+        self.deadline_outcomes.labels(
+            outcome="met" if met else "exceeded").inc()
+        if met and slack_s is not None:
+            self.deadline_slack.observe(max(0.0, slack_s))
 
 
 class GenerationMetrics:
     """LLM-serving observability for a ContinuousBatcher: lane/queue/page
     gauges plus token/request/preemption/prefix-cache counters.  Sampled
     by ``poll(batcher)`` (cheap attribute reads; counters advance by the
-    delta since the last poll, so rate() works in PromQL)."""
+    delta since the last poll, so rate() works in PromQL).
+
+    Latency DISTRIBUTIONS (TTFT, inter-token latency, queue wait, end to
+    end) are event-driven, not polled: pass this object as the batcher's
+    ``metrics=`` and it observes every completed request at the source —
+    the distinction the inference-frameworks-benchmark line in PAPERS.md
+    shows actually separates serving stacks (means hide the tail).
+    ``ttft_quantiles()`` / ``itl_quantiles()`` feed bench.py's tail-latency
+    rows from sliding-window reservoirs (exact quantiles, not bucket
+    interpolation)."""
 
     def __init__(self, namespace: str = "tpulab",
                  registry: Optional["CollectorRegistry"] = None):
@@ -200,7 +290,58 @@ class GenerationMetrics:
         self.prefix_misses = Counter(
             f"{ns}_llm_prefix_cache_misses", "Prefix pages computed fresh",
             registry=self.registry)
+        # -- latency distributions (observed per request by the batcher) ----
+        self.ttft = Histogram(
+            f"{ns}_llm_ttft_seconds",
+            "Time to first token (submit -> first emitted token)",
+            buckets=TTFT_BUCKETS, registry=self.registry)
+        self.itl = Histogram(
+            f"{ns}_llm_inter_token_seconds",
+            "Inter-token latency (per decoded token after the first)",
+            buckets=ITL_BUCKETS, registry=self.registry)
+        self.queue_wait = Histogram(
+            f"{ns}_llm_queue_wait_seconds",
+            "Submit -> prefill start (lane + page admission wait)",
+            buckets=TTFT_BUCKETS, registry=self.registry)
+        self.e2e = Histogram(
+            f"{ns}_llm_e2e_seconds",
+            "Submit -> last token (completed requests)",
+            buckets=E2E_BUCKETS, registry=self.registry)
+        self.deadline_expired = Counter(
+            f"{ns}_llm_deadline_expired_total",
+            "Requests the batcher cancelled at deadline expiry",
+            registry=self.registry)
+        self._ttft_res = _Reservoir()
+        self._itl_res = _Reservoir()
         self._last: Dict[str, int] = {}
+
+    # -- event hooks (called by the batcher; see engine/paged.py) -----------
+    def observe_queue_wait(self, seconds: float) -> None:
+        self.queue_wait.observe(max(0.0, seconds))
+
+    def observe_ttft(self, seconds: float) -> None:
+        seconds = max(0.0, seconds)
+        self.ttft.observe(seconds)
+        self._ttft_res.observe(seconds)
+
+    def observe_itl(self, seconds: float) -> None:
+        seconds = max(0.0, seconds)
+        self.itl.observe(seconds)
+        self._itl_res.observe(seconds)
+
+    def observe_e2e(self, seconds: float) -> None:
+        self.e2e.observe(max(0.0, seconds))
+
+    def note_deadline_expired(self) -> None:
+        self.deadline_expired.inc()
+
+    def ttft_quantiles(self) -> Dict[str, float]:
+        return {f"p{int(q * 100)}": self._ttft_res.quantile(q)
+                for q in _QUANTILES}
+
+    def itl_quantiles(self) -> Dict[str, float]:
+        return {f"p{int(q * 100)}": self._itl_res.quantile(q)
+                for q in _QUANTILES}
 
     def _advance(self, counter, key: str, value: int) -> None:
         delta = value - self._last.get(key, 0)
@@ -214,8 +355,10 @@ class GenerationMetrics:
         self.queued.set(batcher.queued_requests)
         try:
             self.free_pages.set(batcher.pool.free_pages)
-        except Exception:  # pragma: no cover - closed pool during teardown
-            pass
+        except AttributeError:  # closed/absent pool during teardown (a
+            pass                # wrapped engine without .pool, or a pool
+            #                     whose accounting died with close()) — any
+            #                     other failure is a real bug and raises
         self._advance(self.tokens, "tokens", batcher.tokens_generated)
         self._advance(self.completed, "completed",
                       batcher.completed_requests)
@@ -226,8 +369,65 @@ class GenerationMetrics:
             self._advance(self.prefix_misses, "misses", pc.misses)
 
 
+class ChaosMetrics:
+    """Fault-injection telemetry: one counter per (trip point, action), fed
+    by the :func:`tpulab.chaos.set_observer` hook — a chaos experiment is
+    then self-measuring (the injected-fault count sits on the same /metrics
+    endpoint as the breaker/deadline reactions it provoked)."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        self.injections = Counter(
+            f"{namespace}_chaos_injections_total",
+            "Chaos rules fired, keyed by trip point and action",
+            ["point", "action"], registry=self.registry)
+
+    def observe(self, point: str, action: str) -> None:
+        self.injections.labels(point=point, action=action).inc()
+
+    def install(self) -> "ChaosMetrics":
+        """Register as the process-wide chaos fire observer."""
+        from tpulab import chaos
+        chaos.set_observer(self.observe)
+        return self
+
+    def uninstall(self) -> None:
+        from tpulab import chaos
+        chaos.set_observer(None)
+
+
+class MultiRegistryCollector:
+    """Aggregating collector: exposes several CollectorRegistry instances
+    through one registry (hence one /metrics port).  Metric names must be
+    disjoint across the sub-registries — true by construction for the
+    collectors in this module (``_request_*`` / ``_replica_*`` / ``_llm_*``
+    / ``_chaos_*`` prefixes)."""
+
+    def __init__(self, registries: Sequence["CollectorRegistry"]):
+        self._registries = list(registries)
+
+    def collect(self):
+        for reg in self._registries:
+            yield from reg.collect()
+
+
 def start_metrics_server(metrics, port: int = 9090):
-    """Expose /metrics (reference Exposer on :8080).  Accepts any metrics
-    holder with a ``registry`` attribute (InferenceMetrics,
-    ReplicaSetMetrics, ...)."""
-    return start_http_server(port, registry=metrics.registry)
+    """Expose /metrics (reference Exposer on :8080).
+
+    ``metrics`` is a metrics holder with a ``registry`` attribute
+    (InferenceMetrics, ReplicaSetMetrics, GenerationMetrics, ChaosMetrics,
+    ...), a bare CollectorRegistry, or a list/tuple of either — multiple
+    holders are aggregated behind ONE port via
+    :class:`MultiRegistryCollector` (a serving process exports its
+    request, routing, generation and chaos telemetry from a single
+    scrape target)."""
+    if isinstance(metrics, (list, tuple)):
+        agg = CollectorRegistry()
+        agg.register(MultiRegistryCollector(
+            [getattr(m, "registry", m) for m in metrics]))
+        return start_http_server(port, registry=agg)
+    return start_http_server(port, registry=getattr(metrics, "registry",
+                                                    metrics))

@@ -7,6 +7,11 @@ equivalent adds the XLA profiler).
   (the nvtx-range analog the reference lacked).
 - :class:`StageTimer` — the TimedBenchmarkWorkspace pattern as a reusable
   context: named stage durations with blocking sync at boundaries.
+- :class:`TraceContext` / :class:`ChromeTraceRecorder` /
+  :func:`merge_chrome_traces` — request-scoped distributed tracing: the
+  client mints a trace id, carries it over gRPC (request field + metadata),
+  both processes tag their spans with it, and the saved traces merge into
+  ONE chrome://tracing / perfetto timeline (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -15,7 +20,63 @@ import contextlib
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, Iterable, Optional
+
+#: gRPC metadata key carrying the trace id (request-field carriage is the
+#: primary channel; the metadata rides along for middleboxes/interceptors
+#: that never parse the payload)
+TRACE_METADATA_KEY = "tpulab-trace-id"
+
+
+def mint_trace_id() -> str:
+    """16-hex request-scoped trace id (random; no coordination needed)."""
+    import uuid
+    return uuid.uuid4().hex[:16]
+
+
+class TraceContext:
+    """One request's trace identity, propagated client -> server.
+
+    The client mints it once per logical request (NOT per attempt — a
+    failover replay keeps the id, so all attempts line up under one
+    request in the merged timeline); servers recover it from the request
+    message's ``trace_id`` field or the ``tpulab-trace-id`` gRPC metadata.
+    """
+
+    __slots__ = ("trace_id",)
+
+    def __init__(self, trace_id: Optional[str] = None):
+        self.trace_id = trace_id or mint_trace_id()
+
+    def metadata(self) -> tuple:
+        """gRPC call metadata carrying this context."""
+        return ((TRACE_METADATA_KEY, self.trace_id),)
+
+    @classmethod
+    def from_metadata(cls, metadata: Optional[Iterable]) -> Optional["TraceContext"]:
+        """Parse from an iterable of (key, value) pairs; None when absent."""
+        for k, v in metadata or ():
+            if k == TRACE_METADATA_KEY and v:
+                return cls(str(v))
+        return None
+
+    @classmethod
+    def of_request(cls, request, grpc_context=None) -> Optional["TraceContext"]:
+        """Server-side recovery: the request's ``trace_id`` field first,
+        else the invocation metadata; None for untraced requests."""
+        rid = getattr(request, "trace_id", "")
+        if rid:
+            return cls(rid)
+        if grpc_context is not None and hasattr(grpc_context,
+                                                "invocation_metadata"):
+            try:
+                return cls.from_metadata(grpc_context.invocation_metadata())
+            except Exception:  # pragma: no cover - exotic grpc shims
+                return None
+        return None
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.trace_id})"
 
 
 @contextlib.contextmanager
@@ -97,12 +158,20 @@ class ChromeTraceRecorder:
     server keeps the most recent window rather than growing without
     limit)."""
 
-    def __init__(self, max_events: int = 100_000):
+    def __init__(self, max_events: int = 100_000,
+                 process_name: Optional[str] = None):
         import collections
         self._events = collections.deque(maxlen=max_events)
         self._lock = threading.Lock()
+        # paired clock anchor: _epoch0 is the wall-clock instant at which
+        # perf_counter read _t0.  Event ts stay perf_counter-relative (sub-
+        # microsecond deltas within the process); the anchor rides the
+        # saved file so merge_chrome_traces can re-base traces from
+        # DIFFERENT processes onto one wall-clock axis.
         self._t0 = time.perf_counter()
+        self._epoch0 = time.time()
         self._pid = os.getpid()
+        self.process_name = process_name
 
     def add_span(self, name: str, start_s: float, dur_s: float,
                  tid: Optional[int] = None, **args) -> None:
@@ -122,10 +191,52 @@ class ChromeTraceRecorder:
             return len(self._events)
 
     def save(self, path: str) -> str:
+        """Atomic write (tmp + rename): a concurrent reader — e.g. the
+        merge step polling another process's autosaved trace — never
+        observes a torn JSON document."""
         import json
         with self._lock:
             events = list(self._events)
-        with open(path, "w") as f:
-            json.dump({"traceEvents": events,
-                       "displayTimeUnit": "ms"}, f)
+        if self.process_name:
+            events.insert(0, {"name": "process_name", "ph": "M",
+                              "pid": self._pid, "tid": 0,
+                              "args": {"name": self.process_name}})
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"epoch_origin_s": self._epoch0,
+                             "pid": self._pid}}
+        tmp = f"{path}.tmp.{self._pid}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
         return path
+
+
+def merge_chrome_traces(out_path: str, *paths: str) -> str:
+    """Merge per-process Chrome trace files into ONE timeline.
+
+    Each input carries its recorder's ``epoch_origin_s`` anchor (wall
+    clock at its events' ts=0); events are shifted by the anchor deltas so
+    spans from different processes line up on one wall-clock axis (cross-
+    machine accuracy = NTP skew — fine for the >=100us spans recorded
+    here).  Events keep their pid, so perfetto shows one process track per
+    input.  Metadata ('M') events pass through unshifted."""
+    import json
+    docs = []
+    for p in paths:
+        with open(p) as f:
+            docs.append(json.load(f))
+    origins = [float(d.get("otherData", {}).get("epoch_origin_s", 0.0))
+               for d in docs]
+    base = min((o for o in origins if o), default=0.0)
+    merged = []
+    for doc, origin in zip(docs, origins):
+        shift_us = (origin - base) * 1e6 if origin else 0.0
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") != "M":
+                ev = dict(ev, ts=round(ev.get("ts", 0.0) + shift_us, 3))
+            merged.append(ev)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged, "displayTimeUnit": "ms",
+                   "otherData": {"epoch_origin_s": base,
+                                 "merged_from": len(docs)}}, f)
+    return out_path
