@@ -70,6 +70,19 @@ def main():
                     help="expose LLM serving metrics (tpulab_llm_*: "
                          "tokens/s, lanes, pages, prefix-cache, "
                          "preemptions) on this /metrics port")
+    ap.add_argument("--max-inflight", type=int, default=0,
+                    help="admission control (docs/SERVING.md): cap "
+                         "concurrently admitted generations; overflow "
+                         "fast-fails with RESOURCE_EXHAUSTED + "
+                         "retry_after_ms (0 = admission off unless "
+                         "--tenant-rate is set)")
+    ap.add_argument("--tenant-rate", type=float, default=0.0,
+                    help="admission control: per-tenant request rate "
+                         "limit in req/s (tenant = request tenant_id or "
+                         "tpulab-tenant metadata; 0 = no rate limit)")
+    ap.add_argument("--tenant", default="",
+                    help="client mode: tenant identity to send "
+                         "(admission-control fairness/rate bucket)")
     ap.add_argument("--oneshot", action="store_true",
                     help="server exits after first client disconnect (tests)")
     args = ap.parse_args()
@@ -86,6 +99,8 @@ def main():
         kw = dict(temperature=args.temperature, top_p=args.top_p,
                   seed=args.seed, priority=args.priority, stop_tokens=stops,
                   device_sampling=args.device_sampling)
+        if args.tenant:
+            kw["tenant_id"] = args.tenant
         if "," in args.connect:
             # N replicas: least-loaded routing + exactly-once crash
             # failover (tpulab.rpc.replica.GenerationReplicaSet) — the
@@ -183,13 +198,28 @@ def main():
         threading.Thread(target=poll_loop, daemon=True,
                          name="llm-metrics").start()
 
+    admission = None
+    if args.max_inflight or args.tenant_rate:
+        # the QoS frontend gate (docs/SERVING.md): bounded inflight/queue,
+        # per-tenant fair queuing, rate limits, overload fast-fail — sized
+        # to the batcher so cost-aware admission sees real page pressure
+        from tpulab.serving import AdmissionConfig, AdmissionController
+        max_inflight = args.max_inflight or 2 * args.lanes
+        admission = AdmissionController(
+            AdmissionConfig(max_inflight=max_inflight,
+                            max_queue_depth=4 * max_inflight,
+                            tenant_rate=args.tenant_rate),
+            load=cb)
+
     # generation-only deployment: no dense models, just the Generate RPC
     mgr = tpulab.InferenceManager(max_exec_concurrency=1)
-    mgr.serve(port=args.port, generation_engines=engines)
+    mgr.serve(port=args.port, generation_engines=engines,
+              admission=admission)
     print(f"LLM server on :{mgr.server.bound_port} "
           f"(lanes={args.lanes} max_len={args.max_len} "
           f"int8={args.int8} kv_fp8={args.kv_fp8} "
-          f"kernel={cb.use_kernel} flash_prefill={cb.prefill_flash})",
+          f"kernel={cb.use_kernel} flash_prefill={cb.prefill_flash} "
+          f"admission={'on' if admission else 'off'})",
           flush=True)
     import time
     try:

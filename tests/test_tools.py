@@ -72,6 +72,42 @@ def test_build_engine_cli_roundtrip(tmp_path):
     assert next(iter(logits.values())).shape == (2, 10)
 
 
+def test_gen_inference_pb2_schema_drift_and_roundtrip():
+    """tools/gen_inference_pb2.py vs the checked-in inference_pb2 module:
+    the full field/enum inventory must match (proto regeneration drift is
+    caught in tier-1, not at the next regen), and the admission-control
+    schema additions — RESOURCE_EXHAUSTED, retry_after_ms, tenant_id, the
+    Status load gauges — round-trip through serialization."""
+    import tools.gen_inference_pb2 as gen
+    from tpulab.rpc.protos import inference_pb2 as pb
+
+    fd = gen.build_file()
+    gen_msgs = {m.name: sorted((f.name, f.number) for f in m.field)
+                for m in fd.message_type}
+    mod_msgs = {name: sorted((f.name, f.number)
+                             for f in getattr(pb, name).DESCRIPTOR.fields)
+                for name in gen_msgs}
+    assert gen_msgs == mod_msgs, "generator drifted from inference_pb2.py"
+    gen_enums = {v.name: v.number
+                 for e in fd.enum_type for v in e.value}
+    assert gen_enums == dict(pb.StatusCode.items())
+
+    # runtime roundtrips of the admission-control fields
+    assert pb.RESOURCE_EXHAUSTED == 6
+    st = pb.RequestStatus.FromString(pb.RequestStatus(
+        code=pb.RESOURCE_EXHAUSTED, retry_after_ms=125).SerializeToString())
+    assert st.code == pb.RESOURCE_EXHAUSTED and st.retry_after_ms == 125
+    gr = pb.GenerateRequest.FromString(pb.GenerateRequest(
+        prompt=[1, 2], steps=3, tenant_id="team-a").SerializeToString())
+    assert gr.tenant_id == "team-a"
+    ir = pb.InferRequest.FromString(pb.InferRequest(
+        model_name="m", tenant_id="team-a").SerializeToString())
+    assert ir.tenant_id == "team-a"
+    sr = pb.StatusResponse.FromString(pb.StatusResponse(
+        queued_requests=4, free_kv_pages=99).SerializeToString())
+    assert sr.queued_requests == 4 and sr.free_kv_pages == 99
+
+
 # -- capture policy (stubbed attempts; no device needed) ----------------------
 def _bc(monkeypatch, recs):
     import importlib

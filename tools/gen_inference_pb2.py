@@ -64,6 +64,9 @@ def build_file() -> dp.FileDescriptorProto:
         # empty = untraced.  Spans on both sides tag themselves with it so
         # client and server Chrome traces merge into one timeline.
         field("trace_id", 6, F.TYPE_STRING),
+        # admission-control tenant identity (serving/admission.py); empty =
+        # the default tenant.  Also rides the `tpulab-tenant` metadata key.
+        field("tenant_id", 7, F.TYPE_STRING),
     ])
 
     m = fd.message_type.add(name="InferResponse")
@@ -78,6 +81,10 @@ def build_file() -> dp.FileDescriptorProto:
     m.field.extend([
         field("code", 1, F.TYPE_ENUM, type_name="StatusCode"),
         field("message", 2, F.TYPE_STRING),
+        # RESOURCE_EXHAUSTED hint: how long the client should back off
+        # before retrying (0 = no hint).  Clients add jitter on top
+        # (rpc.client.jittered_backoff_s).
+        field("retry_after_ms", 3, F.TYPE_UINT64),
     ])
 
     m = fd.message_type.add(name="ModelIOSpec")
@@ -105,6 +112,11 @@ def build_file() -> dp.FileDescriptorProto:
         field("models", 1, F.TYPE_MESSAGE, REP, "ModelStatus"),
         field("status", 2, F.TYPE_MESSAGE, type_name="RequestStatus"),
         field("server_version", 3, F.TYPE_STRING),
+        # live load gauges (replica routers break inflight ties on them):
+        # requests waiting for capacity (admission queue + batcher queues)
+        # and free KV-cache pages across continuous-batching engines
+        field("queued_requests", 4, F.TYPE_INT64),
+        field("free_kv_pages", 5, F.TYPE_INT64),
     ])
 
     fd.message_type.add(name="HealthRequest")
@@ -134,6 +146,8 @@ def build_file() -> dp.FileDescriptorProto:
         field("deadline_ms", 12, F.TYPE_UINT64),
         # request-scoped trace/request id (see InferRequest.trace_id)
         field("trace_id", 13, F.TYPE_STRING),
+        # admission-control tenant identity (see InferRequest.tenant_id)
+        field("tenant_id", 14, F.TYPE_STRING),
     ])
     m.oneof_decl.add(name="_seed")
 
@@ -149,7 +163,11 @@ def build_file() -> dp.FileDescriptorProto:
     e = fd.enum_type.add(name="StatusCode")
     for name, num in (("INVALID", 0), ("SUCCESS", 1), ("UNKNOWN_MODEL", 2),
                       ("INVALID_ARGUMENT", 3), ("INTERNAL", 4),
-                      ("DEADLINE_EXCEEDED", 5)):
+                      ("DEADLINE_EXCEEDED", 5),
+                      # admission-control fast-fail: the replica is
+                      # overloaded, not broken — retry elsewhere/later
+                      # (honor RequestStatus.retry_after_ms)
+                      ("RESOURCE_EXHAUSTED", 6)):
         e.value.add(name=name, number=num)
     return fd
 
@@ -193,12 +211,22 @@ def main() -> int:
         " pb.GenerateRequest.DESCRIPTOR.fields]);"
         "print('StatusCode:', dict(pb.StatusCode.items()));"
         "r = pb.GenerateRequest(model_name='m', prompt=[1,2], steps=3,"
-        " deadline_ms=250, trace_id='abc123');"
+        " deadline_ms=250, trace_id='abc123', tenant_id='team-a');"
         "r = pb.GenerateRequest.FromString(r.SerializeToString());"
         "assert r.deadline_ms == 250 and r.trace_id == 'abc123';"
-        "ir = pb.InferRequest(model_name='m', trace_id='abc123');"
-        "assert pb.InferRequest.FromString(ir.SerializeToString())"
-        ".trace_id == 'abc123';"
+        "assert r.tenant_id == 'team-a';"
+        "ir = pb.InferRequest(model_name='m', trace_id='abc123',"
+        " tenant_id='team-a');"
+        "ir = pb.InferRequest.FromString(ir.SerializeToString());"
+        "assert ir.trace_id == 'abc123' and ir.tenant_id == 'team-a';"
+        "st = pb.RequestStatus(code=pb.RESOURCE_EXHAUSTED,"
+        " retry_after_ms=125);"
+        "st = pb.RequestStatus.FromString(st.SerializeToString());"
+        "assert st.code == pb.RESOURCE_EXHAUSTED == 6;"
+        "assert st.retry_after_ms == 125;"
+        "sr = pb.StatusResponse(queued_requests=4, free_kv_pages=99);"
+        "sr = pb.StatusResponse.FromString(sr.SerializeToString());"
+        "assert sr.queued_requests == 4 and sr.free_kv_pages == 99;"
         "r2 = pb.GenerateRequest();"
         "assert not r2.HasField('seed');"
         "r2.seed = 9; assert r2.HasField('seed');"

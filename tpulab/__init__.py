@@ -12,6 +12,7 @@ Layer map (mirrors reference trtlab/CMakeLists.txt:2-19 layering):
     tpulab.tpu       device layer (topology, sync, host<->HBM staging)
     tpulab.engine    executable runtime (Runtime/Model/InferenceManager/...)
     tpulab.rpc       async gRPC microservice framework
+    tpulab.serving   admission control & QoS frontend (docs/SERVING.md)
     tpulab.models    model zoo (ResNet, MNIST, transformer) in pure JAX
     tpulab.ops       Pallas kernels + attention ops
     tpulab.parallel  mesh/sharding, DP dispatch, ring attention

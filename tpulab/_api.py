@@ -35,20 +35,26 @@ class InferenceManager(_EngineManager):
               executor=None, batching: bool = False,
               batch_window_s: float = 0.002,
               metrics=None, generation_engines=None,
-              watchdog=None, trace=None) -> "InferenceManager":
+              watchdog=None, trace=None,
+              admission=None) -> "InferenceManager":
         """Expose registered models over the TRTIS-style gRPC service
         (reference manager.serve() -> BasicInferService).  ``batching=True``
         enables server-side dynamic batching across concurrent callers;
         ``generation_engines={name: GenerationEngine}`` serves token
         streaming over the Generate RPC; ``trace=ChromeTraceRecorder()``
-        records per-request lifecycle spans (utils.tracing)."""
+        records per-request lifecycle spans (utils.tracing);
+        ``admission=AdmissionController(...)`` (tpulab.serving) arms the
+        QoS frontend gate — overloaded requests fast-fail with
+        RESOURCE_EXHAUSTED + retry_after_ms instead of queueing without
+        bound (docs/SERVING.md)."""
         if not self._allocated:
             # generation-only serving needs no dense models
             self.update_resources(allow_empty=bool(generation_engines))
         self._server = build_infer_service(
             self, f"0.0.0.0:{port}", executor=executor, batching=batching,
             batch_window_s=batch_window_s, metrics=metrics, trace=trace,
-            generation_engines=generation_engines, watchdog=watchdog)
+            generation_engines=generation_engines, watchdog=watchdog,
+            admission=admission)
         if wait:
             self._server.run()
         else:

@@ -47,6 +47,9 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
     rpc.client.unary          ClientUnary.start, before the call (drop-capable)
     rpc.client.stream_recv    ClientStreaming read loop, per response
     rpc.server.generate_token GenerateContext dense loop, per token (kill site)
+    serving.admission         AdmissionController.admit — error/drop force a
+                              RESOURCE_EXHAUSTED rejection (synthetic
+                              overload), delay models a slow decision
     engine.step               ContinuousBatcher tick + GenerationSession.step
     engine.prefill            ContinuousBatcher fused prefill
     device.transfer           Bindings.copy_to_device (host->device staging)

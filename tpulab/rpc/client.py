@@ -27,6 +27,24 @@ from tpulab.core.async_compute import SharedPackagedTask
 _WRITES_DONE = object()
 
 
+def jittered_backoff_s(retry_after_ms: int, attempt: int = 0,
+                       floor_s: float = 0.05, cap_s: float = 30.0,
+                       jitter: float = 0.5, rng=None) -> float:
+    """Client backoff honoring a server ``retry_after_ms`` hint.
+
+    The hint (floored at ``floor_s`` when the server sent none) doubles
+    per ``attempt`` and is capped; the result is then jittered uniformly
+    over ``[1 - jitter, 1] × delay`` so a fleet of rejected clients
+    decorrelates instead of re-arriving as the same thundering herd that
+    caused the rejection (RESOURCE_EXHAUSTED contract, docs/SERVING.md).
+    """
+    import random
+    base = max(floor_s, retry_after_ms / 1e3)
+    delay = min(cap_s, base * (2.0 ** max(0, attempt)))
+    r = (rng or random).random()
+    return delay * (1.0 - jitter + jitter * r)
+
+
 class ClientExecutor:
     """Round-robin channel pool (reference client Executor)."""
 

@@ -63,11 +63,15 @@ class InferResources(Resources):
     def __init__(self, manager, batching: bool = False,
                  batch_window_s: float = 0.002, metrics=None,
                  generation_engines: Optional[Dict[str, object]] = None,
-                 watchdog=None, trace=None):
+                 watchdog=None, trace=None, admission=None):
         self.manager = manager
         self.metrics = metrics
         #: optional tpulab.utils.tracing.ChromeTraceRecorder
         self.trace = trace
+        #: optional tpulab.serving.AdmissionController — the QoS frontend
+        #: gate (None = admission off, the default: requests pay one
+        #: is-None branch and nothing else)
+        self.admission = admission
         self.batching = batching
         self.generation_engines = generation_engines or {}
         self.watchdog = watchdog
@@ -147,11 +151,29 @@ class InferResources(Resources):
 
 
 class StatusContext(Context):
-    """Model-listing RPC (reference StatusContext infer.cc:547-594)."""
+    """Model-listing RPC (reference StatusContext infer.cc:547-594), plus
+    live load gauges: requests waiting for capacity (admission queue +
+    batcher queues) and free KV pages — replica routers break inflight
+    ties on them (least-loaded preference)."""
 
     def execute_rpc(self, request: pb.StatusRequest) -> pb.StatusResponse:
-        mgr = self.get_resources(InferResources).manager
+        res = self.get_resources(InferResources)
+        mgr = res.manager
         resp = pb.StatusResponse(server_version=SERVER_VERSION)
+        queued = 0
+        if res.admission is not None:
+            queued += res.admission.queue_depth
+        free_pages = 0
+        for eng in res.generation_engines.values():
+            queued += int(getattr(eng, "queued_requests", 0) or 0)
+            pool = getattr(eng, "pool", None)
+            if pool is not None:
+                try:
+                    free_pages += int(pool.free_pages)
+                except Exception:  # torn-down pool: report what we can
+                    pass
+        resp.queued_requests = queued
+        resp.free_kv_pages = free_pages
         names = ([request.model_name] if request.model_name
                  else mgr.model_names)
         for name in names:
@@ -229,6 +251,30 @@ class InferContext(Context):
             resp.status.message = str(e)
             return resp
         res = self.get_resources(InferResources)
+        ticket = None
+        if res.admission is not None:
+            # QoS gate AFTER request validation (a malformed request is
+            # INVALID_ARGUMENT, never a retry-after) and BEFORE any pooled
+            # resource: a rejected request consumes nothing downstream
+            from tpulab.serving.admission import (AdmissionRejected,
+                                                  tenant_of_request)
+            deadline = None
+            g = self.grpc_context
+            if g is not None and hasattr(g, "time_remaining"):
+                rem = g.time_remaining()
+                if rem is not None:
+                    deadline = Deadline.after(rem)
+            tc0 = TraceContext.of_request(request, self.grpc_context)
+            try:
+                ticket = res.admission.admit(
+                    tenant=tenant_of_request(request, self.grpc_context),
+                    cost=max(1, request.batch_size), deadline=deadline,
+                    trace_id=tc0.trace_id if tc0 is not None else None)
+            except AdmissionRejected as e:
+                resp.status.code = pb.RESOURCE_EXHAUSTED
+                resp.status.message = str(e)
+                resp.status.retry_after_ms = e.retry_after_ms
+                return resp
         try:
             import time as _time
             runner = res.runner(request.model_name)
@@ -278,6 +324,9 @@ class InferContext(Context):
             log.exception("inference failed")
             resp.status.code = pb.INTERNAL
             resp.status.message = str(e)
+        finally:
+            if ticket is not None:
+                ticket.release()
         return resp
 
 
@@ -330,7 +379,11 @@ class StreamInferContext(StreamingContext):
         def run():
             try:
                 try:
-                    resp = InferContext(res).execute_rpc(request)
+                    ictx = InferContext(res)
+                    # stream's transport context rides along so admission
+                    # sees the tenant metadata and transport deadline
+                    ictx.grpc_context = self.grpc_context
+                    resp = ictx.execute_rpc(request)
                 except BaseException as e:  # noqa: BLE001 - always respond
                     resp = pb.InferResponse(
                         model_name=request.model_name,
@@ -391,18 +444,27 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                         batch_window_s: float = 0.002,
                         metrics=None,
                         generation_engines: Optional[Dict[str, object]] = None,
-                        watchdog=None, trace=None) -> Server:
+                        watchdog=None, trace=None, admission=None) -> Server:
     """Wire the inference service onto a Server
     (reference BasicInferService ctor infer.cc:644-678).
 
     ``batching=True`` turns on server-side dynamic batching: concurrent unary
     Infer calls aggregate into one device batch per model (examples/03's
-    middleman capability, in-process)."""
+    middleman capability, in-process).  ``admission`` is an optional
+    :class:`tpulab.serving.AdmissionController`: the QoS frontend gate
+    enforced on Infer / StreamInfer / Generate before any pooled resource
+    is touched (docs/SERVING.md); rejected requests get
+    ``RESOURCE_EXHAUSTED`` + ``retry_after_ms``."""
+    if admission is not None and trace is not None \
+            and getattr(admission, "trace", None) is None:
+        # adopt the service's recorder: admission-decision spans land on
+        # the same timeline as the request lifecycle spans
+        admission.trace = trace
     resources = InferResources(manager, batching=batching,
                                batch_window_s=batch_window_s, metrics=metrics,
                                trace=trace,
                                generation_engines=generation_engines,
-                               watchdog=watchdog)
+                               watchdog=watchdog, admission=admission)
     server = Server(address, executor or Executor(n_threads=4))
     server._infer_resources = resources  # for shutdown
     service = AsyncService(SERVICE_NAME, resources)
@@ -507,8 +569,46 @@ class GenerateContext(StreamingContext):
                 code=pb.INVALID_ARGUMENT,
                 message=f"prompt token ids outside [0, {vocab})")))
             return
+        deadline = self._deadline_of(request)
+        ticket = None
+        if res.admission is not None:
+            ok, ticket = self._admit(request, res, deadline)
+            if not ok:
+                return
+        try:
+            self._run_engine(engine, request, deadline)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def _admit(self, request: pb.GenerateRequest, res: InferResources,
+               deadline):
+        """QoS gate for both generation paths, AFTER request validation
+        (a malformed request is INVALID_ARGUMENT, never a retry-after)
+        and BEFORE any lane/page/session lease.  Returns ``(ok, ticket)``;
+        on rejection the final RESOURCE_EXHAUSTED response (with the
+        ``retry_after_ms`` backoff hint) has already been written."""
+        from tpulab.serving.admission import (AdmissionRejected,
+                                              tenant_of_request)
+        tc = TraceContext.of_request(request, self.grpc_context)
+        try:
+            return True, res.admission.admit(
+                tenant=tenant_of_request(request, self.grpc_context),
+                cost=len(request.prompt) + request.steps,
+                priority=request.priority, deadline=deadline,
+                trace_id=tc.trace_id if tc is not None else None)
+        except AdmissionRejected as e:
+            st = pb.RequestStatus(code=pb.RESOURCE_EXHAUSTED,
+                                  message=str(e),
+                                  retry_after_ms=e.retry_after_ms)
+            self.write(pb.GenerateResponse(final=True, status=st))
+            return False, None
+
+    def _run_engine(self, engine, request: pb.GenerateRequest,
+                    deadline) -> None:
+        res = self.get_resources(InferResources)
         if getattr(engine, "continuous_batching", False):  # explicit marker
-            self._run_paged(engine, request)
+            self._run_paged(engine, request, deadline)
             return
         if (request.temperature > 0.0 or request.priority != 0
                 or request.return_logprobs):
@@ -521,7 +621,6 @@ class GenerateContext(StreamingContext):
                         "priority and logprobs require a continuous-batching "
                         "backend")))
             return
-        deadline = self._deadline_of(request)
         # trace: queue(lease wait)/prefill/decode-chunk spans on this
         # worker's row, tagged with the client's trace id (merged-timeline
         # contract, docs/OBSERVABILITY.md).  All span bookkeeping is gated
@@ -613,7 +712,8 @@ class GenerateContext(StreamingContext):
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
                 code=pb.INTERNAL, message=str(e))))
 
-    def _run_paged(self, engine, request: pb.GenerateRequest) -> None:
+    def _run_paged(self, engine, request: pb.GenerateRequest,
+                   deadline=None) -> None:
         """Continuous-batching path: tokens stream from the batcher's
         on_token hook; many RPCs share the fused decode ticks.  Client
         disconnects cancel the batcher request (lane/pages free at the next
@@ -630,7 +730,6 @@ class GenerateContext(StreamingContext):
 
         fut = None
         res = self.get_resources(InferResources)
-        deadline = self._deadline_of(request)
         if (res.trace is not None and getattr(engine, "trace", None) is None
                 and hasattr(engine, "trace")):
             # adopt the service's recorder once: the batcher then records
@@ -717,11 +816,29 @@ class GenerationRejected(RuntimeError):
 
     @property
     def retryable(self) -> bool:
-        """INTERNAL may be a transient engine fault; deterministic
-        request errors are not worth a second replica's time, and an
-        expired deadline is a GLOBAL budget — no replica can beat it."""
+        """INTERNAL may be a transient engine fault and
+        RESOURCE_EXHAUSTED is one replica's overload (another may have
+        room); deterministic request errors are not worth a second
+        replica's time, and an expired deadline is a GLOBAL budget — no
+        replica can beat it."""
         return self.code not in (pb.UNKNOWN_MODEL, pb.INVALID_ARGUMENT,
                                  pb.DEADLINE_EXCEEDED)
+
+
+class ResourceExhausted(GenerationRejected):
+    """Admission-control fast-fail: the replica is OVERLOADED, not broken
+    (docs/SERVING.md).  Routers treat it as neither a success nor a
+    replica fault — route away with backoff instead of tripping the
+    circuit breaker — and ``retry_after_ms`` carries the server's backoff
+    hint (clients add jitter: :func:`tpulab.rpc.client.jittered_backoff_s`)."""
+
+    def __init__(self, message: str, retry_after_ms: int = 0):
+        RuntimeError.__init__(
+            self, f"admission rejected: {message}"
+            + (f" (retry after {retry_after_ms}ms)" if retry_after_ms
+               else ""))
+        self.code = pb.RESOURCE_EXHAUSTED
+        self.retry_after_ms = int(retry_after_ms)
 
 
 class GenerateStreamClient:
@@ -737,7 +854,8 @@ class GenerateStreamClient:
                  stop_tokens=(), device_sampling: bool = False,
                  return_logprobs: bool = False, top_p: float = 0.0,
                  deadline_s: Optional[float] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 tenant_id: Optional[str] = None):
         """Yields token ids; with ``return_logprobs=True`` yields
         ``(token, logprob)`` pairs instead.
 
@@ -749,7 +867,11 @@ class GenerateStreamClient:
         ``timeout`` remains the per-activity stall bound (no stream
         progress for that long = the replica is stuck).  ``trace_id``
         (utils.tracing) rides the request AND the gRPC metadata so server
-        spans join the client's trace timeline."""
+        spans join the client's trace timeline.  ``tenant_id``
+        (serving/admission.py) is the admission-control identity: it rides
+        the request and the ``tpulab-tenant`` metadata; an overloaded
+        server fast-fails with :class:`ResourceExhausted` carrying its
+        ``retry_after_ms`` backoff hint."""
         import queue as _q
         deadline = Deadline.after(deadline_s)
         out: "_q.Queue" = _q.Queue()
@@ -759,13 +881,16 @@ class GenerateStreamClient:
         # ``timeout`` deliberately does NOT become a transport deadline: a
         # healthy stream may run longer than any single-activity bound.
         rem0 = deadline.remaining()
+        metadata = list(TraceContext(trace_id).metadata()) if trace_id else []
+        if tenant_id:
+            from tpulab.serving.admission import TENANT_METADATA_KEY
+            metadata.append((TENANT_METADATA_KEY, tenant_id))
         stream = ClientStreaming(
             self._manager._executor, f"/{SERVICE_NAME}/Generate", out.put,
             pb.GenerateRequest.SerializeToString,
             pb.GenerateResponse.FromString,
             timeout=None if rem0 is None else rem0 + 2.0,
-            metadata=(list(TraceContext(trace_id).metadata())
-                      if trace_id else None))
+            metadata=metadata or None)
         # a dead stream must wake the consumer promptly, not via timeout
         _STREAM_DEAD = object()
         stream.done().add_done_callback(lambda _f: out.put(_STREAM_DEAD))
@@ -779,6 +904,8 @@ class GenerateStreamClient:
             return_logprobs=return_logprobs)
         if trace_id:
             req.trace_id = trace_id
+        if tenant_id:
+            req.tenant_id = tenant_id
         if seed is not None:
             req.seed = seed
         rem = deadline.remaining()
@@ -809,6 +936,9 @@ class GenerateStreamClient:
                     if resp.status.code == pb.DEADLINE_EXCEEDED:
                         raise DeadlineExceeded(resp.status.message
                                                or "deadline exceeded")
+                    if resp.status.code == pb.RESOURCE_EXHAUSTED:
+                        raise ResourceExhausted(resp.status.message,
+                                                resp.status.retry_after_ms)
                     if resp.status.code not in (pb.SUCCESS, 0):
                         raise GenerationRejected(resp.status.code,
                                                  resp.status.message)
@@ -851,6 +981,16 @@ class RemoteInferenceManager:
         if resp.status.code != pb.SUCCESS:
             raise RuntimeError(f"Status failed: {resp.status.message}")
         return {m.name: m for m in resp.models}
+
+    def server_status(self,
+                      timeout: Optional[float] = None) -> pb.StatusResponse:
+        """The raw StatusResponse, including the live load gauges
+        (``queued_requests`` / ``free_kv_pages``) replica routers use to
+        break inflight ties."""
+        return self._status.call(pb.StatusRequest(), timeout=timeout)
+
+    def server_status_async(self):
+        return self._status.start(pb.StatusRequest())
 
     def infer_runner(self, model_name: str,
                      timeout: Optional[float] = None) -> "InferRemoteRunner":
@@ -958,7 +1098,7 @@ class InferRemoteRunner:
                 for s in self.status.outputs}
 
     def infer(self, requested_outputs=None, timeout=None, trace_id=None,
-              **arrays: np.ndarray):
+              tenant_id=None, **arrays: np.ndarray):
         """Future of dict-of-numpy outputs.
 
         ``requested_outputs`` optionally names a subset of the model's
@@ -967,9 +1107,12 @@ class InferRemoteRunner:
         per-attempt budget replica routers derive from an end-to-end
         deadline.  ``trace_id`` (utils.tracing) rides the request and the
         gRPC metadata so the server's lifecycle spans join the client's
-        trace.  Model inputs literally named ``requested_outputs``,
-        ``timeout`` or ``trace_id`` still work: ndarray values are rebound
-        as inputs.
+        trace.  ``tenant_id`` (serving/admission.py) is the admission-
+        control identity; an overloaded server fails the future with
+        :class:`ResourceExhausted` (its ``retry_after_ms`` is the backoff
+        hint).  Model inputs literally named ``requested_outputs``,
+        ``timeout``, ``trace_id`` or ``tenant_id`` still work: ndarray
+        values are rebound as inputs.
         """
         if isinstance(requested_outputs, np.ndarray):
             arrays["requested_outputs"] = requested_outputs
@@ -980,25 +1123,35 @@ class InferRemoteRunner:
         if isinstance(trace_id, np.ndarray):
             arrays["trace_id"] = trace_id
             trace_id = None
+        if isinstance(tenant_id, np.ndarray):
+            arrays["tenant_id"] = tenant_id
+            tenant_id = None
         if not arrays:
             raise ValueError("no input arrays")
         batch = next(iter(arrays.values())).shape[0]
         req = pb.InferRequest(model_name=self.model_name, batch_size=batch)
         if trace_id:
             req.trace_id = trace_id
+        if tenant_id:
+            req.tenant_id = tenant_id
         if requested_outputs:
             req.requested_outputs.extend(requested_outputs)
         for name, arr in arrays.items():
             req.inputs.append(tensor_to_proto(name, arr))
 
         def on_complete(resp: pb.InferResponse) -> Dict[str, np.ndarray]:
+            if resp.status.code == pb.RESOURCE_EXHAUSTED:
+                raise ResourceExhausted(resp.status.message,
+                                        resp.status.retry_after_ms)
             if resp.status.code != pb.SUCCESS:
                 raise RuntimeError(
                     f"remote inference failed ({pb.StatusCode.Name(resp.status.code)}): "
                     f"{resp.status.message}")
             return {t.name: proto_to_tensor(t) for t in resp.outputs}
 
+        metadata = list(TraceContext(trace_id).metadata()) if trace_id else []
+        if tenant_id:
+            from tpulab.serving.admission import TENANT_METADATA_KEY
+            metadata.append((TENANT_METADATA_KEY, tenant_id))
         return self._mgr._infer.start(
-            req, on_complete, timeout=timeout,
-            metadata=(list(TraceContext(trace_id).metadata())
-                      if trace_id else None))
+            req, on_complete, timeout=timeout, metadata=metadata or None)

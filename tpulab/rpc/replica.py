@@ -97,7 +97,8 @@ class _BaseReplicaSet:
                  metrics=None, breaker_threshold: int = 3,
                  probe_backoff_s: float = 0.25,
                  probe_backoff_cap_s: float = 30.0,
-                 probe_timeout_s: float = 5.0, trace=None):
+                 probe_timeout_s: float = 5.0, trace=None,
+                 overload_retries: int = 1):
         if not addresses:
             raise ValueError("need at least one replica address")
         self.addresses = list(addresses)
@@ -109,6 +110,19 @@ class _BaseReplicaSet:
         self.served = [0] * len(self._managers)
         self._lock = threading.Lock()
         self._rr = 0  # tie-break rotation cursor
+        # -- overload routing (RESOURCE_EXHAUSTED admission fast-fails) -----
+        # an overloaded replica is NOT a dead replica: it never counts
+        # toward the breaker streak; instead routing backs off it for the
+        # server's jittered retry_after window, and when EVERY replica is
+        # overloaded the request itself waits one jittered retry-after
+        # round (up to ``overload_retries`` rounds) before re-spreading
+        self._backoff_until = [0.0] * len(self._managers)
+        self._overload_retries = max(0, overload_retries)
+        #: cumulative RESOURCE_EXHAUSTED fast-fails observed (tests)
+        self.overloads = 0
+        #: last server-reported queued_requests per replica (Status RPC,
+        #: refreshed by poll_load()) — the inflight tie-breaker
+        self._load_hint = [0] * len(self._managers)
         self._max_failover = (len(self._managers) if max_failover is None
                               else max_failover)
         # -- circuit breaker (0/None disables) ------------------------------
@@ -217,6 +231,27 @@ class _BaseReplicaSet:
             self._fail_streak[idx] = 0
             if idx in self._open:
                 self._restore_locked(idx, "traffic")
+
+    def _record_overload(self, idx: int, retry_after_ms: int) -> None:
+        """A RESOURCE_EXHAUSTED admission fast-fail: overload is not a
+        dead replica, so the breaker streak is untouched — routing just
+        avoids the replica for a jittered retry-after window."""
+        from tpulab.rpc.client import jittered_backoff_s
+        until = time.monotonic() + jittered_backoff_s(retry_after_ms)
+        with self._lock:
+            self.overloads += 1
+            self._backoff_until[idx] = max(self._backoff_until[idx], until)
+
+    def _overload_wait_s(self, retry_after_ms: int, round_no: int,
+                         deadline: Deadline) -> Optional[float]:
+        """The jittered whole-request backoff once EVERY replica is
+        overloaded; None when the deadline cannot afford the wait."""
+        from tpulab.rpc.client import jittered_backoff_s
+        delay = jittered_backoff_s(retry_after_ms, attempt=round_no)
+        rem = deadline.remaining()
+        if rem is not None and rem <= delay:
+            return None
+        return delay
 
     def _record_failure(self, idx: int) -> None:
         """A replica fault (transport error, timeout, retryable engine
@@ -346,17 +381,49 @@ class _BaseReplicaSet:
                     1 if h["live"] else 0)
         return out
 
+    # -- reported load (Status RPC gauges) ----------------------------------
+    def poll_load(self, timeout: float = 5.0) -> Dict[str, dict]:
+        """Refresh each replica's server-reported load (StatusResponse
+        ``queued_requests`` / ``free_kv_pages``) — the tie-break hint
+        ``_pick_locked`` prefers.  Dead replicas keep their last hint
+        (they are routed around by health/breaker, not by load)."""
+        out: Dict[str, dict] = {}
+        futs = []
+        for i, (a, m) in enumerate(zip(self.addresses, self._managers)):
+            try:
+                futs.append((i, a, m.server_status_async()))
+            except Exception as e:  # noqa: BLE001 - submission failed
+                out[a] = {"error": f"{type(e).__name__}: {e}"}
+        for i, addr, fut in futs:
+            try:
+                resp = fut.result(timeout=timeout)
+                out[addr] = {"queued_requests": int(resp.queued_requests),
+                             "free_kv_pages": int(resp.free_kv_pages)}
+                with self._lock:
+                    self._load_hint[i] = int(resp.queued_requests)
+            except Exception as e:  # noqa: BLE001 - dead replica is data
+                out[addr] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
     # -- dispatch -----------------------------------------------------------
     def _pick_locked(self, exclude: frozenset) -> Optional[int]:
-        """Least-loaded with round-robin tie-breaking (sequential traffic
-        rotates instead of piling onto index 0 — envoy's round-robin
-        behavior at the tie).  Breaker-open replicas are skipped, UNLESS
-        every non-excluded replica is open (an all-dead set still
-        attempts traffic — the attempt doubles as a live probe).
-        CALLER HOLDS self._lock; does NOT bump inflight — the single
-        shared selection algorithm."""
+        """Least-loaded with server-reported-load tie-breaking, then
+        round-robin (sequential traffic rotates instead of piling onto
+        index 0 — envoy's round-robin behavior at the tie).  Breaker-open
+        and overload-backoff replicas are skipped, with graceful
+        fallbacks: backoff is ignored before open is (a merely-overloaded
+        replica beats a dead one), and when every non-excluded replica is
+        open the pick still attempts traffic (the attempt doubles as a
+        live probe).  CALLER HOLDS self._lock; does NOT bump inflight —
+        the single shared selection algorithm."""
+        now = time.monotonic()
         candidates = [(n, i) for i, n in enumerate(self._inflight)
-                      if i not in exclude and i not in self._open]
+                      if i not in exclude and i not in self._open
+                      and self._backoff_until[i] <= now]
+        if not candidates:  # everyone healthy is backing off: prefer an
+            #                 overloaded replica over a breaker-open one
+            candidates = [(n, i) for i, n in enumerate(self._inflight)
+                          if i not in exclude and i not in self._open]
         if not candidates:
             candidates = [(n, i) for i, n in enumerate(self._inflight)
                           if i not in exclude]
@@ -364,6 +431,13 @@ class _BaseReplicaSet:
             return None
         lo = min(n for n, _ in candidates)
         tied = [i for n, i in candidates if n == lo]
+        if len(tied) > 1:
+            # inflight tie: prefer the replica whose LAST REPORTED load
+            # (Status RPC queued_requests, poll_load()) is lowest — local
+            # inflight is this client's view only; the hint folds in what
+            # every other client is doing.  RR still rotates full ties.
+            lo_hint = min(self._load_hint[i] for i in tied)
+            tied = [i for i in tied if self._load_hint[i] == lo_hint]
         idx = tied[self._rr % len(tied)]
         self._rr += 1
         return idx
@@ -463,7 +537,8 @@ class ReplicaSet(_BaseReplicaSet):
 
     def _submit(self, outer: Future, arrays: dict, attempts_left: int,
                 exclude: frozenset, deadline: Deadline,
-                trace_id: Optional[str] = None) -> None:
+                trace_id: Optional[str] = None,
+                overload_round: int = 0) -> None:
         if deadline.expired():
             self._deadline_failed(outer, deadline)
             return
@@ -490,13 +565,36 @@ class ReplicaSet(_BaseReplicaSet):
                 if not outer.done():
                     outer.set_result(fut.result())
                 return
-            self._record_failure(idx)
+            from tpulab.rpc.infer_service import ResourceExhausted
+            overloaded = isinstance(exc, ResourceExhausted)
+            if overloaded:
+                # overload is not a dead replica: back off, don't eject
+                self._record_overload(idx, exc.retry_after_ms)
+            else:
+                self._record_failure(idx)
             if deadline.expired():
                 self._deadline_failed(outer, deadline)
             elif attempts_left > 1 and not outer.done():
                 self._note_failover()
                 self._submit(outer, arrays, attempts_left - 1,
-                             exclude | {idx}, deadline, trace_id)
+                             exclude | {idx}, deadline, trace_id,
+                             overload_round)
+            elif (overloaded and overload_round < self._overload_retries
+                    and not outer.done()):
+                # every replica fast-failed overloaded: honor the server's
+                # retry-after hint (jittered) once per round, then
+                # re-spread across the whole set
+                delay = self._overload_wait_s(exc.retry_after_ms,
+                                              overload_round, deadline)
+                if delay is None:  # deadline cannot afford the wait
+                    outer.set_exception(exc)
+                    return
+                timer = threading.Timer(
+                    delay, self._submit,
+                    args=(outer, arrays, self._max_failover, frozenset(),
+                          deadline, trace_id, overload_round + 1))
+                timer.daemon = True
+                timer.start()
             elif not outer.done():
                 outer.set_exception(exc)
 
@@ -516,7 +614,8 @@ class ReplicaSet(_BaseReplicaSet):
             if attempts_left > 1 and not deadline.expired():
                 self._note_failover()
                 self._submit(outer, arrays, attempts_left - 1,
-                             exclude | {idx}, deadline, trace_id)
+                             exclude | {idx}, deadline, trace_id,
+                             overload_round)
             else:
                 outer.set_exception(e)
 
@@ -614,6 +713,7 @@ class GenerationReplicaSet(_BaseReplicaSet):
         # the server spans it produces) shares it in the merged timeline
         trace_id = kw.pop("trace_id", None) or mint_trace_id()
         attempt = 0
+        overload_round = 0
         while True:
             if deadline.expired():
                 self._note_deadline(False, deadline)
@@ -651,7 +751,30 @@ class GenerationReplicaSet(_BaseReplicaSet):
             except Exception as e:
                 self._note_attempt(e)
                 self._attempt_span(t_att, idx, attempt, trace_id, e)
-                from tpulab.rpc.infer_service import GenerationRejected
+                from tpulab.rpc.infer_service import (GenerationRejected,
+                                                      ResourceExhausted)
+                if isinstance(e, ResourceExhausted):
+                    # admission fast-fail: overload is not a dead replica
+                    # (no breaker streak) — back this replica off and
+                    # route away; once EVERY replica is overloaded, honor
+                    # the server's retry-after hint (jittered) and
+                    # re-spread, up to ``overload_retries`` rounds
+                    self._record_overload(idx, e.retry_after_ms)
+                    exclude.add(idx)
+                    attempt += 1
+                    if len(exclude) < len(self._managers):
+                        self._note_failover()
+                        continue
+                    if overload_round >= self._overload_retries:
+                        raise
+                    delay = self._overload_wait_s(e.retry_after_ms,
+                                                  overload_round, deadline)
+                    if delay is None:
+                        raise  # deadline cannot afford the backoff
+                    overload_round += 1
+                    time.sleep(delay)
+                    exclude.clear()
+                    continue
                 if isinstance(e, GenerationRejected) and not e.retryable:
                     # the server processed and rejected the request —
                     # identical on every replica, don't burn them all

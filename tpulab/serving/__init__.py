@@ -1,0 +1,14 @@
+"""Serving frontend: admission control & QoS (docs/SERVING.md).
+
+The explicit layer between the gRPC services and the engines: decide at
+the RPC boundary whether a request runs, waits in a per-tenant fair
+queue, or fails fast with ``RESOURCE_EXHAUSTED`` + ``retry_after_ms`` —
+before it consumes a lane, KV pages, or a session lease.
+"""
+
+from tpulab.serving.admission import (DEFAULT_TENANT,  # noqa: F401
+                                      TENANT_METADATA_KEY, AdmissionConfig,
+                                      AdmissionController, AdmissionRejected,
+                                      AdmissionTicket, TokenBucket,
+                                      tenant_of_request)
+from tpulab.serving.fair_queue import DeficitRoundRobinQueue  # noqa: F401

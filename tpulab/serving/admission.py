@@ -1,0 +1,498 @@
+"""Admission control & QoS for the serving frontend.
+
+trtlab's only backpressure is implicit — callers block on resource-pool
+leases (SURVEY §2.5) — which collapses under heavy traffic: every request
+is accepted, queues grow without bound, and decode steps are burned on
+requests whose deadlines expired while they waited.  This module is the
+explicit admission layer the ROADMAP north star calls for: decide AT THE
+RPC BOUNDARY whether a request should run, wait, or fail fast — before it
+consumes a lane, KV pages, or a session lease (the cost/performance/
+resilience balancing of the adaptive-orchestration line in PAPERS.md).
+
+:class:`AdmissionController` composes, in decision order:
+
+1. **Token-bucket rate limits** — global and per-tenant (identity from
+   the request's ``tenant_id`` field or the ``tpulab-tenant`` gRPC
+   metadata key).  Rate rejections fail fast with a ``retry_after_ms``
+   hint; they never occupy queue space.
+2. **Bounded inflight + queue-depth caps, cost-aware** — estimated cost
+   is ``prompt tokens + steps``; a request is only dispatched when the
+   attached load source (a :class:`~tpulab.engine.paged.ContinuousBatcher`)
+   has the free KV pages and lane headroom to run it.
+3. **Deadline-aware early rejection** — predicted queue wait (EWMA of
+   observed service time × queue position) exceeding the remaining
+   ``deadline_ms`` rejects immediately instead of burning decode steps on
+   a request that cannot finish in time.
+4. **Priority-ordered load shedding** — when the bounded queue overflows,
+   the globally lowest-priority queued request is shed first; an arrival
+   that does not outrank the lowest queued request is itself rejected.
+5. **Deficit-round-robin fair queuing** (serving/fair_queue.py) — queued
+   admissions dispatch in cost-weighted round robin across tenants, so
+   one greedy tenant cannot starve the rest.
+
+Every rejection carries a machine-readable ``reason`` and a
+``retry_after_ms`` hint; the RPC layer maps it to the
+``RESOURCE_EXHAUSTED`` status and clients honor the hint with jittered
+backoff (``rpc/client.py::jittered_backoff_s``, replica sets route away).
+
+The ``serving.admission`` chaos trip point (tpulab.chaos) forces the
+overload path on demand: an ``error``/``drop`` rule converts to a
+rejection (reason ``chaos``), ``delay`` models a slow admission decision.
+
+Disarmed cost: services built without a controller pay one ``is None``
+branch per request — the default-off contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from tpulab import chaos
+from tpulab.core.deadline import Deadline
+from tpulab.serving.fair_queue import DeficitRoundRobinQueue
+
+#: gRPC metadata key carrying the tenant identity (the request's
+#: ``tenant_id`` field is the primary channel; metadata rides along for
+#: middleboxes that never parse the payload — mirrors the trace-id pair)
+TENANT_METADATA_KEY = "tpulab-tenant"
+
+#: tenant label for requests that carry no identity
+DEFAULT_TENANT = "default"
+
+#: rejection reasons (the ``reason`` label on AdmissionMetrics.rejected)
+REJECT_REASONS = ("global_rate", "tenant_rate", "queue_full", "shed",
+                  "deadline", "queue_timeout", "chaos")
+
+
+def tenant_of_request(request, grpc_context=None,
+                      default: str = DEFAULT_TENANT) -> str:
+    """Server-side tenant recovery: the request's ``tenant_id`` field
+    first, else the ``tpulab-tenant`` invocation metadata, else the
+    default tenant (mirrors TraceContext.of_request)."""
+    t = getattr(request, "tenant_id", "")
+    if t:
+        return str(t)
+    if grpc_context is not None and hasattr(grpc_context,
+                                            "invocation_metadata"):
+        try:
+            for k, v in grpc_context.invocation_metadata() or ():
+                if k == TENANT_METADATA_KEY and v:
+                    return str(v)
+        except Exception:  # pragma: no cover - exotic grpc shims
+            pass
+    return default
+
+
+class AdmissionRejected(RuntimeError):
+    """The admission controller refused the request.  ``reason`` is one
+    of :data:`REJECT_REASONS`; ``retry_after_ms`` is the server's backoff
+    hint (0 = no hint, e.g. the request's own deadline was the limit)."""
+
+    def __init__(self, reason: str, message: str, retry_after_ms: int = 0):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_ms = int(retry_after_ms)
+
+
+@dataclass
+class AdmissionConfig:
+    """Admission knobs (docs/SERVING.md).  ``max_inflight`` bounds
+    concurrently admitted requests; ``max_queue_depth`` bounds waiting
+    ones — together they are the whole memory footprint of overload.
+    Rates are requests/second (0 disables a bucket); bursts default to
+    one second of rate (min 1).  ``drr_quantum`` is the fair-queue
+    quantum in cost units (estimated tokens).  ``expected_service_s``
+    seeds the service-time EWMA the wait predictor uses before any
+    completion has been observed."""
+
+    max_inflight: int = 8
+    max_queue_depth: int = 32
+    global_rate: float = 0.0
+    global_burst: float = 0.0
+    tenant_rate: float = 0.0
+    tenant_burst: float = 0.0
+    drr_quantum: int = 512
+    admit_wait_s: float = 30.0
+    min_retry_after_ms: int = 25
+    max_retry_after_ms: int = 5000
+    expected_service_s: float = 0.0
+    #: distinct per-tenant buckets kept before the stalest is evicted
+    #: (an unauthenticated tenant header must not be a memory leak)
+    tenant_bucket_cap: int = 4096
+
+
+class TokenBucket:
+    """Lazy-refill token bucket.  NOT internally locked — the controller's
+    lock guards it (``clock`` injectable for deterministic tests)."""
+
+    __slots__ = ("rate", "burst", "_tokens", "_t", "_clock")
+
+    def __init__(self, rate: float, burst: float = 0.0, clock=time.monotonic):
+        if rate <= 0:
+            raise ValueError("rate must be > 0")
+        self.rate = float(rate)
+        self.burst = float(burst) if burst > 0 else max(1.0, self.rate)
+        self._tokens = self.burst
+        self._clock = clock
+        self._t = clock()
+
+    def _refill(self, now: float) -> None:
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._t) * self.rate)
+        self._t = now
+
+    def try_take(self, n: float = 1.0) -> bool:
+        self._refill(self._clock())
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    def retry_after_s(self, n: float = 1.0) -> float:
+        """Seconds until ``n`` tokens will be available (0 if already)."""
+        self._refill(self._clock())
+        missing = n - self._tokens
+        return 0.0 if missing <= 0 else missing / self.rate
+
+
+class AdmissionTicket:
+    """One admitted request's capacity hold; ``release()`` (or context
+    exit) returns it and dispatches the next queued admission."""
+
+    __slots__ = ("tenant", "cost", "queue_wait_s", "_ctrl", "_t_admit",
+                 "_released")
+
+    def __init__(self, ctrl: "AdmissionController", tenant: str, cost: int,
+                 queue_wait_s: float):
+        self.tenant = tenant
+        self.cost = cost
+        self.queue_wait_s = queue_wait_s
+        self._ctrl = ctrl
+        self._t_admit = time.perf_counter()
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._ctrl._on_release(self)
+
+    def __enter__(self) -> "AdmissionTicket":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _Waiter:
+    """A queued admission request (entry in the DRR queue)."""
+
+    __slots__ = ("tenant", "cost", "priority", "deadline", "seq", "event",
+                 "ticket", "reject", "t_enqueue")
+
+    def __init__(self, tenant: str, cost: int, priority: int,
+                 deadline: Optional[Deadline], seq: int):
+        self.tenant = tenant
+        self.cost = cost
+        self.priority = priority
+        self.deadline = deadline
+        self.seq = seq
+        self.event = threading.Event()
+        self.ticket: Optional[AdmissionTicket] = None
+        self.reject: Optional[AdmissionRejected] = None
+        self.t_enqueue = time.perf_counter()
+
+
+class AdmissionController:
+    """The serving frontend's admission decision (module docstring).
+
+    ``load`` is an optional load source for cost-aware admission — any
+    object exposing ContinuousBatcher's surface (``lanes``,
+    ``active_lanes``, ``queued_requests``, ``page_size``,
+    ``pool.free_pages``); absent attributes disable that signal.
+    ``metrics`` is an optional
+    :class:`tpulab.utils.metrics.AdmissionMetrics`; ``trace`` an optional
+    ChromeTraceRecorder (one ``admission`` span per decision).
+    """
+
+    def __init__(self, config: Optional[AdmissionConfig] = None,
+                 load=None, metrics=None, trace=None):
+        self.config = config or AdmissionConfig()
+        self._load = load
+        self._metrics = metrics
+        self.trace = trace
+        cfg = self.config
+        self._lock = threading.Lock()
+        self._queue = DeficitRoundRobinQueue(quantum=cfg.drr_quantum)
+        self._inflight = 0
+        self._seq = 0
+        self._global_bucket = (TokenBucket(cfg.global_rate, cfg.global_burst)
+                               if cfg.global_rate > 0 else None)
+        self._tenant_buckets: Dict[str, TokenBucket] = {}
+        self._service_ewma = (cfg.expected_service_s
+                              if cfg.expected_service_s > 0 else None)
+        # -- observability (test-assertable without prometheus) -------------
+        self.admitted_total = 0
+        self.rejected_total = 0
+        self.shed_total = 0
+        self.rejected_by_reason: Dict[str, int] = {}
+        self.peak_queue_depth = 0
+
+    # -- load signals --------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def _capacity_ok_locked(self, cost: int) -> bool:
+        """Cost-aware dispatch gate: the load source must have the free KV
+        pages to hold ``cost`` tokens and lane headroom to schedule the
+        request soon (at most one lane-set's worth queued inside the
+        engine — the admission queue is where waiting happens)."""
+        eng = self._load
+        if eng is None:
+            return True
+        try:
+            pool = getattr(eng, "pool", None)
+            if pool is not None:
+                page_size = int(getattr(eng, "page_size", 0)
+                                or getattr(pool, "page_size", 1))
+                if pool.free_pages * max(1, page_size) < cost:
+                    return False
+            lanes = int(getattr(eng, "lanes", 0) or 0)
+            if lanes and (int(getattr(eng, "active_lanes", 0)) >= lanes
+                          and int(getattr(eng, "queued_requests", 0))
+                          >= lanes):
+                return False
+        except Exception:  # a torn-down pool must not wedge admission
+            return True
+        return True
+
+    # -- estimators ----------------------------------------------------------
+    def _predicted_wait_locked(self, position: int) -> float:
+        """Expected queue wait at ``position`` (0 = head): EWMA service
+        time × slots ahead / parallelism.  0 before any observation — a
+        guess must not reject real traffic."""
+        if self._service_ewma is None:
+            return 0.0
+        par = max(1, self.config.max_inflight)
+        return self._service_ewma * (position + 1) / par
+
+    def _retry_hint_ms_locked(self) -> int:
+        cfg = self.config
+        est = self._predicted_wait_locked(len(self._queue))
+        ms = int(est * 1e3) if est > 0 else cfg.min_retry_after_ms
+        return max(cfg.min_retry_after_ms, min(cfg.max_retry_after_ms, ms))
+
+    # -- the decision --------------------------------------------------------
+    def admit(self, tenant: str = "", cost: int = 1, priority: int = 0,
+              deadline: Optional[Deadline] = None,
+              trace_id: Optional[str] = None) -> AdmissionTicket:
+        """Admit (possibly after a bounded fair-queue wait) or raise
+        :class:`AdmissionRejected`.  ``cost`` is estimated tokens
+        (prompt + steps) for generation, batch size for dense inference.
+        The returned ticket MUST be released when the request finishes
+        (context manager)."""
+        t0 = time.perf_counter()
+        tenant = tenant or DEFAULT_TENANT
+        cost = max(1, int(cost))
+        try:
+            # chaos: force the overload path on demand (error/drop -> a
+            # synthetic rejection; delay -> a slow admission decision)
+            try:
+                if chaos.trip("serving.admission") == "drop":
+                    raise chaos.ChaosError("injected admission drop")
+            except chaos.ChaosError as e:
+                raise AdmissionRejected(
+                    "chaos", f"admission chaos: {e}",
+                    retry_after_ms=self.config.min_retry_after_ms)
+            ticket, waiter = self._admit_or_enqueue(tenant, cost, priority,
+                                                    deadline)
+            if ticket is None:  # queued: wait for dispatch/shed/expiry
+                ticket = self._wait(waiter, deadline)
+        except AdmissionRejected as e:
+            self._note_rejected(e, tenant, t0, trace_id)
+            raise
+        self._note_admitted(ticket, tenant, t0, trace_id)
+        return ticket
+
+    def _admit_or_enqueue(self, tenant: str, cost: int, priority: int,
+                          deadline: Optional[Deadline]):
+        cfg = self.config
+        with self._lock:
+            # 1) rate limits fail fast — a bucket that says "not now" must
+            # not convert rate limiting into queueing
+            b = self._global_bucket
+            if b is not None and not b.try_take():
+                raise AdmissionRejected(
+                    "global_rate", "global request rate exceeded",
+                    retry_after_ms=max(cfg.min_retry_after_ms,
+                                       int(b.retry_after_s() * 1e3)))
+            if cfg.tenant_rate > 0:
+                tb = self._tenant_buckets.get(tenant)
+                if tb is None:
+                    if len(self._tenant_buckets) >= cfg.tenant_bucket_cap:
+                        stale = min(self._tenant_buckets,
+                                    key=lambda t: self._tenant_buckets[t]._t)
+                        del self._tenant_buckets[stale]
+                    tb = self._tenant_buckets[tenant] = TokenBucket(
+                        cfg.tenant_rate, cfg.tenant_burst)
+                if not tb.try_take():
+                    raise AdmissionRejected(
+                        "tenant_rate",
+                        f"tenant {tenant!r} request rate exceeded",
+                        retry_after_ms=max(cfg.min_retry_after_ms,
+                                           int(tb.retry_after_s() * 1e3)))
+            # 2) fast path: capacity now, nobody queued ahead
+            if (self._inflight < cfg.max_inflight and not len(self._queue)
+                    and self._capacity_ok_locked(cost)):
+                self._inflight += 1
+                self._note_pressure_locked()
+                return AdmissionTicket(self, tenant, cost, 0.0), None
+            # 3) deadline-aware early rejection: don't queue a request
+            # that cannot finish in time
+            if deadline is not None:
+                rem = deadline.remaining()
+                predicted = self._predicted_wait_locked(len(self._queue))
+                if rem is not None and predicted > 0 and rem < predicted:
+                    raise AdmissionRejected(
+                        "deadline",
+                        f"predicted queue wait {predicted * 1e3:.0f}ms "
+                        f"exceeds remaining deadline {rem * 1e3:.0f}ms",
+                        retry_after_ms=min(cfg.max_retry_after_ms,
+                                           int(predicted * 1e3)))
+            # 4) bounded queue with lowest-priority-first shedding
+            if len(self._queue) >= cfg.max_queue_depth:
+                victim = self._queue.peek_lowest_priority()
+                if victim is None or victim.priority >= priority:
+                    raise AdmissionRejected(
+                        "queue_full",
+                        f"admission queue full "
+                        f"(depth {len(self._queue)})",
+                        retry_after_ms=self._retry_hint_ms_locked())
+                self._queue.remove(victim)
+                victim.reject = AdmissionRejected(
+                    "shed",
+                    f"shed for a priority-{priority} request "
+                    f"(own priority {victim.priority})",
+                    retry_after_ms=self._retry_hint_ms_locked())
+                victim.event.set()
+            # 5) deficit-round-robin fair queue
+            self._seq += 1
+            w = _Waiter(tenant, cost, priority, deadline, self._seq)
+            self._queue.push(w)
+            self.peak_queue_depth = max(self.peak_queue_depth,
+                                        len(self._queue))
+            self._note_pressure_locked()
+            return None, w
+
+    def _wait(self, w: _Waiter, deadline: Optional[Deadline]
+              ) -> AdmissionTicket:
+        """Block until dispatched, shed, timed out or past deadline.  The
+        short poll doubles as a liveness re-dispatch: pages freed by the
+        engine (not by a ticket release) still unblock the queue."""
+        end = time.monotonic() + self.config.admit_wait_s
+        while True:
+            budget = end - time.monotonic()
+            if deadline is not None:
+                rem = deadline.remaining()
+                if rem is not None:
+                    budget = min(budget, rem)
+            w.event.wait(timeout=max(0.0, min(0.05, budget)))
+            with self._lock:
+                if w.ticket is not None:
+                    self._note_pressure_locked()
+                    return w.ticket
+                if w.reject is not None:
+                    raise w.reject
+                if deadline is not None and deadline.expired():
+                    self._queue.remove(w)
+                    self._note_pressure_locked()
+                    raise AdmissionRejected(
+                        "deadline", "deadline expired while queued",
+                        retry_after_ms=0)
+                if time.monotonic() >= end:
+                    self._queue.remove(w)
+                    self._note_pressure_locked()
+                    raise AdmissionRejected(
+                        "queue_timeout",
+                        f"no capacity within "
+                        f"{self.config.admit_wait_s:g}s",
+                        retry_after_ms=self._retry_hint_ms_locked())
+                self._dispatch_locked()
+
+    def _dispatch_locked(self) -> None:
+        """Move queued waiters into inflight while capacity allows, in
+        DRR order.  A waiter the pool cannot hold yet goes back to the
+        head (pages free continuously; the fairness charge is refunded)."""
+        while self._inflight < self.config.max_inflight and len(self._queue):
+            w = self._queue.pop()
+            if w.deadline is not None and w.deadline.expired():
+                w.reject = AdmissionRejected(
+                    "deadline", "deadline expired while queued",
+                    retry_after_ms=0)
+                w.event.set()
+                continue
+            if not self._capacity_ok_locked(w.cost):
+                self._queue.requeue_front(w, refund=w.cost)
+                break
+            self._inflight += 1
+            w.ticket = AdmissionTicket(
+                self, w.tenant, w.cost,
+                time.perf_counter() - w.t_enqueue)
+            w.event.set()
+
+    def _on_release(self, ticket: AdmissionTicket) -> None:
+        hold_s = time.perf_counter() - ticket._t_admit
+        with self._lock:
+            self._inflight -= 1
+            # EWMA of observed service time feeds the wait predictor
+            self._service_ewma = (hold_s if self._service_ewma is None
+                                  else 0.8 * self._service_ewma
+                                  + 0.2 * hold_s)
+            self._dispatch_locked()
+            self._note_pressure_locked()
+
+    # -- telemetry -----------------------------------------------------------
+    def _note_pressure_locked(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_pressure(len(self._queue), self._inflight)
+
+    def _note_admitted(self, ticket: AdmissionTicket, tenant: str,
+                       t0: float, trace_id: Optional[str]) -> None:
+        with self._lock:
+            self.admitted_total += 1
+        if self._metrics is not None:
+            self._metrics.note_admitted(tenant, ticket.queue_wait_s)
+        if self.trace is not None:
+            args = {"decision": "admit", "tenant": tenant}
+            if trace_id:
+                args["trace_id"] = trace_id
+            self.trace.add_span("admission", t0,
+                                time.perf_counter() - t0, **args)
+
+    def _note_rejected(self, e: AdmissionRejected, tenant: str,
+                       t0: float, trace_id: Optional[str]) -> None:
+        with self._lock:
+            self.rejected_total += 1
+            self.rejected_by_reason[e.reason] = (
+                self.rejected_by_reason.get(e.reason, 0) + 1)
+            if e.reason == "shed":
+                self.shed_total += 1
+        if self._metrics is not None:
+            self._metrics.note_rejected(e.reason, tenant)
+        if self.trace is not None:
+            args = {"decision": "reject", "reason": e.reason,
+                    "tenant": tenant,
+                    "retry_after_ms": e.retry_after_ms}
+            if trace_id:
+                args["trace_id"] = trace_id
+            self.trace.add_span("admission", t0,
+                                time.perf_counter() - t0, **args)

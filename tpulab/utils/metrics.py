@@ -369,6 +369,66 @@ class GenerationMetrics:
             self._advance(self.prefix_misses, "misses", pc.misses)
 
 
+class AdmissionMetrics:
+    """Admission-control telemetry (`_admission_*`; serving/admission.py):
+    admitted/rejected/shed counters keyed by tenant (and rejection
+    reason), queue-wait-at-admission distribution, and live queue/inflight
+    pressure gauges — the overload view docs/SERVING.md reads: *is the
+    frontend shedding, whom, and why*."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.admitted = Counter(
+            f"{ns}_admission_admitted_total", "Requests admitted",
+            ["tenant"], registry=self.registry)
+        self.rejected = Counter(
+            f"{ns}_admission_rejected_total",
+            "Requests rejected at admission, by reason (global_rate, "
+            "tenant_rate, queue_full, shed, deadline, queue_timeout, "
+            "chaos)", ["reason", "tenant"], registry=self.registry)
+        self.shed = Counter(
+            f"{ns}_admission_shed_total",
+            "Queued requests shed for a higher-priority arrival",
+            ["tenant"], registry=self.registry)
+        self.queue_wait = Histogram(
+            f"{ns}_admission_queue_wait_seconds",
+            "Fair-queue wait of ADMITTED requests (arrival -> dispatch)",
+            buckets=TTFT_BUCKETS, registry=self.registry)
+        self.queue_depth = Gauge(
+            f"{ns}_admission_queue_depth",
+            "Requests waiting in the admission fair queue",
+            registry=self.registry)
+        self.inflight = Gauge(
+            f"{ns}_admission_inflight",
+            "Admitted requests currently holding a ticket",
+            registry=self.registry)
+        self._queue_wait_res = _Reservoir()
+
+    # -- hooks (called by AdmissionController) ------------------------------
+    def note_admitted(self, tenant: str, queue_wait_s: float) -> None:
+        self.admitted.labels(tenant=tenant).inc()
+        self.queue_wait.observe(max(0.0, queue_wait_s))
+        self._queue_wait_res.observe(max(0.0, queue_wait_s))
+
+    def note_rejected(self, reason: str, tenant: str) -> None:
+        self.rejected.labels(reason=reason, tenant=tenant).inc()
+        if reason == "shed":
+            self.shed.labels(tenant=tenant).inc()
+
+    def set_pressure(self, queued: int, inflight: int) -> None:
+        self.queue_depth.set(queued)
+        self.inflight.set(inflight)
+
+    def queue_wait_quantiles(self) -> Dict[str, float]:
+        """Exact sliding-window quantiles (bench.py's overload row)."""
+        return {f"p{int(q * 100)}": self._queue_wait_res.quantile(q)
+                for q in _QUANTILES}
+
+
 class ChaosMetrics:
     """Fault-injection telemetry: one counter per (trip point, action), fed
     by the :func:`tpulab.chaos.set_observer` hook — a chaos experiment is
@@ -404,7 +464,7 @@ class MultiRegistryCollector:
     through one registry (hence one /metrics port).  Metric names must be
     disjoint across the sub-registries — true by construction for the
     collectors in this module (``_request_*`` / ``_replica_*`` / ``_llm_*``
-    / ``_chaos_*`` prefixes)."""
+    / ``_admission_*`` / ``_chaos_*`` prefixes)."""
 
     def __init__(self, registries: Sequence["CollectorRegistry"]):
         self._registries = list(registries)
