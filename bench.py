@@ -256,6 +256,11 @@ def _emit_line(timeout_phase: str | None = None) -> None:
             "unit": "inf/s",
             "vs_baseline": round(headline / BASELINE_INF_PER_SEC, 4),
             "device": device,
+            # every recorded round carries its capture time: archived
+            # BENCH_rNN files then age honestly in last_good provenance
+            # instead of reporting "captured_at": null / "unknown age"
+            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime()),
             "details": d,
         }
     if _is_on_device_record(line):
@@ -804,6 +809,21 @@ def main() -> None:
             "source": "GenerationMetrics reservoirs (batcher-observed)"})
     except Exception as e:
         print(f"# llm latency row skipped: {e!r}", file=sys.stderr)
+
+    # multi-step fused decode (docs/PERFORMANCE.md): the same paged
+    # workload at decode-block sizes K=1 vs K>1.  On CPU jit the
+    # dispatch/host-sync counts are the signal (no link RTT to amortize);
+    # on-device the tok/s uplift is — through a relay tunnel the serving
+    # loop pays the full RTT per blocking fetch, and K cuts fetches to
+    # ceil(steps/K) per request.
+    _phase("decode_dispatch")
+    try:
+        from tpulab.engine.paged import benchmark_decode_dispatch
+        _record(decode_dispatch=benchmark_decode_dispatch(
+            ks=(1, 8) if degraded else (1, 4, 8, 16),
+            steps=24 if degraded else 48))
+    except Exception as e:
+        print(f"# decode dispatch row skipped: {e!r}", file=sys.stderr)
 
     # admission control under overload (docs/SERVING.md): offer ~2x the
     # measured capacity with per-request deadlines and record goodput

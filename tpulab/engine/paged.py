@@ -17,6 +17,10 @@ TPU-first mechanics:
   and masks by true length.  (A Pallas ragged-paged kernel that skips the
   gather materialization is the next optimization; the block-table layout is
   already kernel-ready.)
+- decode runs K ticks per dispatch (:func:`paged_decode_block`: lax.scan over
+  the step, on-device sampling + stop masks), so the host pays one dispatch
+  and ONE blocking fetch per K tokens — off-chip the per-token cost is the
+  host<->device RTT, and K amortizes it (docs/PERFORMANCE.md).
 """
 
 from __future__ import annotations
@@ -324,6 +328,71 @@ def paged_decode_step(params, kv_pool, tables, lengths, tokens,
     logprobs = jnp.take_along_axis(logp_rows, next_tokens[:, None],
                                    axis=-1)[:, 0]
     return next_tokens, logprobs, logits, kv_pool
+
+
+def paged_decode_block(params, kv_pool, tables, lengths, tokens, active,
+                       temps, seeds, steps_rem, stop_ids,
+                       n_heads: int, n_layers: int, compute_dtype,
+                       k: int = 8, use_kernel: bool = False,
+                       n_kv_heads: Optional[int] = None,
+                       rope_theta: Optional[float] = None,
+                       kernel_geometry: Optional[tuple] = None):
+    """K fused decode ticks in ONE dispatch: ``lax.scan`` over
+    :func:`paged_decode_step`, sampling every step on device.
+
+    The per-token serving cost off-chip is dominated by the host<->device
+    round trip (dispatch + blocking fetch), not the decode math — chaining
+    K steps inside one compiled program amortizes that RTT over K tokens
+    (the host then syncs once per K tokens instead of once per token, the
+    fused multi-token decode shape of TPU-native serving stacks).
+
+    Per-lane device-side stop mask: a lane is *live* while it is active,
+    has steps remaining, and has not emitted a stop token.  ``steps_rem
+    (B,) i32`` counts tokens still wanted per lane; ``stop_ids (B, S)
+    i32`` holds each lane's stop-token ids padded with -1 (token ids are
+    always >= 0, so the pad never matches).  A stop token IS emitted as
+    the lane's final token (matching the host-side contract), then the
+    lane goes dead for the rest of the block: its K/V writes route to the
+    reserved scratch page and its position stops advancing — which also
+    keeps the (seed, position)-folded device-sampling stream identical to
+    a K=1 run.
+
+    The CALLER pre-allocates pages: step j writes K/V at ``lengths + j``
+    for live lanes, so ``tables`` must already cover every position the
+    block can reach.
+
+    Returns ``(tokens (B, K) i32, logprobs (B, K) f32, emitted (B, K)
+    bool, lengths (B,), last_tokens (B,), live (B,), steps_rem (B,),
+    kv_pool)`` — the trailing five are the carried state *after* the
+    block, returned as device arrays so a follow-up block can be
+    dispatched without a host round trip (dispatch-ahead overlap).
+    ``emitted[b]`` is a prefix mask: lane b's valid tokens are
+    ``tokens[b, :emitted[b].sum()]``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def body(carry, _):
+        kv, lens, toks, live, rem = carry
+        nt, lp, _logits, kv = paged_decode_step(
+            params, kv, tables, lens, toks, live,
+            n_heads=n_heads, n_layers=n_layers,
+            compute_dtype=compute_dtype, use_kernel=use_kernel,
+            n_kv_heads=n_kv_heads, rope_theta=rope_theta,
+            temps=temps, seeds=seeds, kernel_geometry=kernel_geometry)
+        emitted = live
+        nt = jnp.where(live, nt, toks)           # dead lanes hold position
+        lens = lens + emitted.astype(jnp.int32)
+        rem = rem - emitted.astype(jnp.int32)
+        hit_stop = (nt[:, None] == stop_ids).any(axis=1)
+        live = live & (rem > 0) & ~hit_stop
+        return (kv, lens, nt, live, rem), (nt, lp, emitted)
+
+    init = (kv_pool, lengths, tokens, active, steps_rem)
+    (kv_pool, lengths, tokens, live, steps_rem), (toks, lps, ems) = \
+        jax.lax.scan(body, init, None, length=k)
+    return (toks.T, lps.T, ems.T, lengths, tokens, live, steps_rem,
+            kv_pool)
 
 
 def _device_sample_token(row, temp, seed2, pos):
@@ -687,18 +756,39 @@ class ContinuousBatcher:
     """Continuous-batching scheduler over the paged pool.
 
     ``submit(prompt, steps) -> Future[list[int]]``; a background scheduler
-    thread runs one fused decode tick per iteration over up to ``lanes``
-    concurrent requests, admitting queued requests whenever a lane (and
-    pages) free up — no head-of-line draining.  ``cancel(future)`` aborts a
-    request and frees its lane/pages at the next tick boundary.
+    thread runs one fused decode dispatch per iteration over up to
+    ``lanes`` concurrent requests, admitting queued requests whenever a
+    lane (and pages) free up — no head-of-line draining.
+    ``cancel(future)`` aborts a request and frees its lane/pages at the
+    next dispatch boundary.
+
+    Multi-step fused decode: each dispatch covers an adaptive K decode
+    ticks (``decode_block`` is the ceiling) chained on device via
+    :func:`paged_decode_block`, so the host pays ONE dispatch + ONE
+    blocking fetch per K tokens instead of per token — off-chip the
+    per-token cost is the link RTT, and K amortizes it.  Greedy and
+    device-sampled lanes run at full K (sampling and the EOS /
+    steps-remaining stop mask live on device); any host-sampled
+    (``top_k``/``top_p``) lane in the batch drops the whole batch to K=1
+    (its sampling needs the logits row on host every token).  K adapts
+    down to 1-2 when a lane's deadline is tight or a streaming consumer
+    is attached with no queue pressure, so interactive TTFT/ITL does not
+    regress; per-token ``on_token`` callbacks still fire in order, and
+    cancellation/deadline sweeps act at block boundaries (a request stops
+    within at most one block of the sweep observing it).
     """
 
     #: explicit capability marker for routers (e.g. the Generate RPC)
     continuous_batching = True
 
     #: decode tokens per trace span ("each decode chunk"): per-token spans
-    #: would swamp the bounded event ring at serving rates
+    #: would swamp the bounded event ring at serving rates.  K>1 decode
+    #: flushes one span per BLOCK instead (block-sized decode spans).
     TRACE_DECODE_CHUNK = 8
+
+    #: fused-decode block sizes: the adaptive K snaps DOWN onto this menu
+    #: so the jit cache stays tiny (one compiled scan per size in use)
+    BLOCK_K_MENU = (1, 2, 4, 8, 16)
 
     #: shortest max_len at which use_kernel=None auto-selects the pallas
     #: kernel on TPU (below this the only live capture shows the XLA
@@ -716,7 +806,8 @@ class ContinuousBatcher:
                  prefill_chunk: Optional[int] = None,
                  kv_dtype=None,
                  prefill_flash: Optional[bool] = None,
-                 trace=None, metrics=None):
+                 trace=None, metrics=None,
+                 decode_block: int = 8):
         import jax
         import jax.numpy as jnp
 
@@ -769,11 +860,27 @@ class ContinuousBatcher:
                               self.pool.device, n_kv_heads=n_kv,
                               kv_dtype=self.pool.dtype))
         self.use_kernel = bool(use_kernel)
-        self._step = jax.jit(
-            partial(paged_decode_step, n_heads=n_heads, n_layers=n_layers,
-                    compute_dtype=compute_dtype, use_kernel=self.use_kernel,
-                    n_kv_heads=n_kv, rope_theta=rope_theta),
-            donate_argnums=(1,))
+        self._step_kw = dict(n_heads=n_heads, n_layers=n_layers,
+                             compute_dtype=compute_dtype,
+                             use_kernel=self.use_kernel,
+                             n_kv_heads=n_kv, rope_theta=rope_theta)
+        self._step = jax.jit(partial(paged_decode_step, **self._step_kw),
+                             donate_argnums=(1,))
+        if decode_block < 1:
+            raise ValueError("decode_block must be >= 1")
+        #: max fused-decode steps per dispatch (K): a K-block amortizes the
+        #: host<->device round trip over K tokens.  The per-block K is
+        #: adaptive (see _pick_block_k) — this is the ceiling; 1 disables
+        #: multi-step dispatch entirely.
+        self.decode_block = min(int(decode_block), self.BLOCK_K_MENU[-1])
+        self._block_cache: Dict[int, Any] = {}
+        self._pending_block: Optional[Dict[str, Any]] = None
+        self._step_ewma_s = 0.0   # per-scan-step device time estimate
+        # -- dispatch/sync accounting (tokens_per_dispatch telemetry and
+        #    the host-syncs-per-request regression guard read these) ------
+        self.decode_dispatches = 0   # device decode dispatches (any K)
+        self.decode_host_syncs = 0   # blocking device->host decode fetches
+        self.prefill_dispatches = 0  # prefill passes (one per prompt fill)
         if prefill_flash is None:
             # auto: pallas flash attention for the FULL-PROMPT forward on
             # TPU (O(T*block) VMEM instead of a dense (T, T) score
@@ -940,13 +1047,14 @@ class ContinuousBatcher:
         tr.add_span(name, t0, dur, tid=lane, lane=lane, **extra)
 
     def _flush_decode_chunk(self, req: _PagedRequest, lane: int,
-                            now: float) -> None:
-        """Close the open decode-chunk span at ``now`` and start the next."""
+                            now: float, **extra) -> None:
+        """Close the open decode-chunk span at ``now`` and start the next
+        (K>1 dispatch passes ``block=K`` — block-sized decode spans)."""
         n = len(req.tokens_out)
         if req.chunk_t0 is not None and n > req.chunk_start:
             self._span("decode", lane, req.chunk_t0, now - req.chunk_t0,
                        req, first=req.chunk_start,
-                       tokens=n - req.chunk_start)
+                       tokens=n - req.chunk_start, **extra)
         req.chunk_t0 = now
         req.chunk_start = n
 
@@ -1120,6 +1228,9 @@ class ContinuousBatcher:
                     with self._cv:
                         self._cv.wait(timeout=0.01)
             except Exception as e:  # noqa: BLE001 - fail active requests
+                # a dispatched-ahead block died with the pool: its device
+                # arrays and lane mapping are meaningless after recovery
+                self._pending_block = None
                 with self._cv:
                     for lane, req in enumerate(self._active):
                         if req is not None:
@@ -1178,6 +1289,7 @@ class ContinuousBatcher:
         # recovery path (fail actives + pool reset), a delay is a slow
         # prefill under deadline pressure
         chaos.trip("engine.prefill")
+        self.prefill_dispatches += 1
         if start == 0 and (self.prefill_chunk is None
                            or t <= self.prefill_chunk):
             t_pad = 1 << (t - 1).bit_length()  # pow2 bucket: small jit cache
@@ -1301,45 +1413,312 @@ class ContinuousBatcher:
                 logging.getLogger("tpulab.engine").exception(
                     "on_token hook failed")
 
-    def _tick(self, snapshot, jnp) -> None:
-        tables = np.zeros((self.lanes, self.max_pages), np.int32)
-        lengths = np.zeros((self.lanes,), np.int32)
-        tokens = np.zeros((self.lanes,), np.int32)
-        active = np.zeros((self.lanes,), bool)
-        for lane, req in enumerate(snapshot):
-            if req is None:
-                continue
-            # grow the block table when entering a fresh page
-            if req.length // self.page_size >= len(req.pages):
+    # -- fused decode dispatch ----------------------------------------------
+    def _block_fn(self, k: int):
+        """Jitted K-step fused decode (compiled once per block size)."""
+        fn = self._block_cache.get(k)
+        if fn is None:
+            import jax
+            fn = jax.jit(partial(paged_decode_block, k=k, **self._step_kw),
+                         donate_argnums=(1,))
+            self._block_cache[k] = fn
+        return fn
+
+    def _tight_slack_s(self) -> float:
+        """Deadline slack below which a lane counts as *tight* (adaptive K
+        drops to <=2): roughly two max-size blocks of measured decode
+        time, clamped to a sane band while the EWMA warms up."""
+        est = self._step_ewma_s or 0.005
+        return min(1.0, max(0.05, 2.0 * self.decode_block * est))
+
+    def _pick_block_k(self, decode_lanes) -> int:
+        """Adaptive fused-decode block size for this dispatch.
+
+        - any host-sampled (``top_k``/``top_p``) lane -> 1: its per-token
+          pick needs the logits row on host every tick;
+        - any deadline-tight lane -> <=2: the sweep acts at block
+          boundaries, so a big block would overshoot the deadline;
+        - a streaming consumer with NO queue pressure -> <=2: keep ITL
+          smooth when latency is what the caller is buying;
+        - otherwise (throughput pressure, batch-style ``.result()``
+          consumers) the full ``decode_block`` ceiling;
+        - never longer than the largest remaining step budget needs
+          (covering it with one block instead of trailing short blocks).
+        """
+        kmax = self.decode_block
+        if kmax <= 1:
+            return 1
+        now = _time.monotonic()
+        want = kmax
+        streaming = False
+        max_rem = 1
+        for _lane, req in decode_lanes:
+            sp = req.sampling
+            if sp.temperature > 0.0 and not sp.device:
+                return 1
+            if (req.deadline is not None
+                    and req.deadline - now < self._tight_slack_s()):
+                want = min(want, 2)
+            if req.on_token is not None:
+                streaming = True
+            max_rem = max(max_rem, req.steps - len(req.tokens_out))
+        if streaming and not self._queue:
+            want = min(want, 2)
+        cover = next((m for m in self.BLOCK_K_MENU if m >= max_rem),
+                     self.BLOCK_K_MENU[-1])
+        k = min(want, cover)
+        return max(m for m in self.BLOCK_K_MENU if m <= k)
+
+    def _reserve_block_pages(self, decode_lanes, k: int):
+        """Pre-allocate every page the next K appends will write, per lane.
+
+        Decode step j writes K/V at position ``length + j`` — the device
+        cannot allocate, so the block table must cover the whole block
+        BEFORE dispatch.  Appends land at positions >= the prompt length,
+        which always sit in the lane's private pages (the prefix cache
+        only ever shares FULL prompt pages strictly below the write
+        region), so pre-allocation can never hand the block a shared
+        page to write.  Under pool pressure the block shrinks to what
+        every participating lane can cover (snapped down onto
+        BLOCK_K_MENU, surplus pages returned); a lane that cannot cover
+        even one append skips this block entirely (same as the old
+        per-tick starvation skip).  Returns ``(k_eff, [(lane, req,
+        new_pages), ...])``.
+        """
+        parts = []
+        cap = k
+        for lane, req in decode_lanes:
+            appends_want = max(1, min(k, req.steps - len(req.tokens_out)))
+            need = (req.length + appends_want - 1) // self.page_size + 1
+            new: List[int] = []
+            while len(req.pages) < need:
                 page = self._alloc_page()
                 if page is None:
-                    continue  # pool pressure: lane skips this tick
+                    break
                 req.pages.append(page)
-            # prompts are handled by the fused prefill; decode feeds back the
-            # previously generated token
-            if req.pending_prompt or not req.tokens_out:
+                new.append(page)
+            covered = len(req.pages) * self.page_size - req.length
+            appends = min(appends_want, covered)
+            if appends <= 0:
+                for _ in new:  # starved: return the partial take
+                    self.pool.release_pages([req.pages.pop()])
                 continue
+            if appends < appends_want:
+                cap = min(cap, appends)
+            parts.append((lane, req, new))
+        if not parts:
+            return k, []
+        k_eff = max(m for m in self.BLOCK_K_MENU if m <= max(1, cap))
+        if k_eff < k:
+            # shrunk block: give back pages past the new write horizon
+            for _lane, req, new in parts:
+                appends_eff = max(1, min(k_eff,
+                                         req.steps - len(req.tokens_out)))
+                need = (req.length + appends_eff - 1) // self.page_size + 1
+                while len(req.pages) > need and new:
+                    self.pool.release_pages([req.pages.pop()])
+                    new.pop()
+        return k_eff, parts
+
+    def _plan_decode(self, snapshot):
+        """Pick this dispatch's lanes, block size, and page reservations."""
+        decode_lanes = [(lane, req) for lane, req in enumerate(snapshot)
+                        if req is not None and not req.cancelled
+                        and not req.pending_prompt and req.tokens_out]
+        if not decode_lanes:
+            return None
+        k = self._pick_block_k(decode_lanes)
+        k, parts = self._reserve_block_pages(decode_lanes, k)
+        if not parts:
+            return None  # every lane page-starved: caller backs off
+        return {"k": k, "parts": parts}
+
+    def _tick(self, snapshot, jnp) -> bool:
+        """One scheduler decode pass: consume the dispatched-ahead block
+        if one is in flight, else plan + dispatch + consume.  Returns True
+        when any lane made progress, False when every decode lane is
+        starved (pool pressure) or idle."""
+        if self._pending_block is not None:
+            stash, self._pending_block = self._pending_block, None
+            return self._consume_block(stash, jnp)
+        plan = self._plan_decode(snapshot)
+        if plan is None:
+            return False
+        if plan["k"] == 1:
+            return self._tick_single(plan["parts"], jnp)
+        stash = self._dispatch_block(plan["parts"], plan["k"], jnp)
+        return self._consume_block(stash, jnp)
+
+    def _dispatch_block(self, parts, k: int, jnp, carry=None,
+                        host=None):
+        """Issue one K-step fused decode dispatch (async — no host sync).
+
+        ``carry``/``host`` chain a follow-up block from a previous one's
+        device-resident final state (dispatch-ahead overlap) — the block
+        table is rebuilt host-side either way (new pages may have been
+        reserved), but lengths/tokens/live/steps-remaining stay on device
+        so chaining costs no round trip.
+        """
+        b = self.lanes
+        tables = np.zeros((b, self.max_pages), np.int32)
+        lane_reqs = {}
+        for lane, req, _new in parts:
+            lane_reqs[lane] = req
+            tables[lane, :len(req.pages)] = req.pages
+        if host is None:
+            lengths = np.zeros((b,), np.int32)
+            tokens = np.zeros((b,), np.int32)
+            active = np.zeros((b,), bool)
+            temps = np.zeros((b,), np.float32)
+            seeds = np.zeros((b, 2), np.uint32)   # (lo, hi) words
+            rem = np.zeros((b,), np.int32)
+            n_stop = max((len(r.stop_tokens) for _, r, _ in parts),
+                         default=0)
+            width = (1 << (n_stop - 1).bit_length()) if n_stop > 1 else 1
+            stops = np.full((b, width), -1, np.int32)  # ids >= 0: pad safe
+            for lane, req, _new in parts:
+                lengths[lane] = req.length
+                tokens[lane] = req.tokens_out[-1]
+                active[lane] = True
+                rem[lane] = req.steps - len(req.tokens_out)
+                sp = req.sampling
+                if sp.device and sp.temperature > 0.0:
+                    temps[lane] = sp.temperature
+                    seeds[lane] = (sp.seed & 0xFFFFFFFF,
+                                   (sp.seed >> 32) & 0xFFFFFFFF)
+                if req.stop_tokens:
+                    st = sorted(req.stop_tokens)
+                    stops[lane, :len(st)] = st
+        else:
+            temps, seeds, stops = host
+            lengths, tokens, active, rem = carry
+        # chaos: decode fault site — tripped once per DECODE TICK (k times
+        # per block), so a deterministic schedule written against
+        # per-token serving (error@N, per-tick delays) keeps its meaning
+        # under fused blocks; an error fails the in-flight requests and
+        # resets the pool (the scheduler's recovery path)
+        for _ in range(k):
+            chaos.trip("engine.step")
+        t0 = _time.perf_counter()
+        (toks, lps, ems, len_f, tok_f, live_f, rem_f,
+         self.pool.kv) = self._block_fn(k)(
+            self.params, self.pool.kv, jnp.asarray(tables),
+            jnp.asarray(lengths), jnp.asarray(tokens),
+            jnp.asarray(active), jnp.asarray(temps), jnp.asarray(seeds),
+            jnp.asarray(rem), jnp.asarray(stops))
+        self.decode_dispatches += 1
+        return {"k": k, "lane_reqs": lane_reqs, "dev": (toks, lps, ems),
+                "carry": (len_f, tok_f, live_f, rem_f),
+                "host": (temps, seeds, stops), "t0": t0}
+
+    def _consume_block(self, stash, jnp) -> bool:
+        """Fetch a dispatched block (ONE host sync for up to K tokens per
+        lane) and unpack it through the per-token emit/trace/metrics
+        path; may dispatch the NEXT block before running the emit
+        callbacks (overlapping device compute with host-side emit)."""
+        k = stash["k"]
+        toks = np.asarray(stash["dev"][0], np.int32)
+        lps = np.asarray(stash["dev"][1], np.float32)
+        ems = np.asarray(stash["dev"][2], bool)
+        self.decode_host_syncs += 1
+        now = _time.perf_counter()  # post-fetch: device work is done
+        self._step_ewma_s = (
+            0.8 * self._step_ewma_s + 0.2 * ((now - stash["t0"]) / k)
+            if self._step_ewma_s else (now - stash["t0"]) / k)
+        emits: List = []
+        completed: List = []
+        clean = True        # every dispatched lane is still this request's
+        emitted_total = 0
+        with self._cv:
+            for lane, req in stash["lane_reqs"].items():
+                if self._active[lane] is not req or req.cancelled:
+                    # released (cancel/deadline sweep) or preempted since
+                    # dispatch: its block tokens are DISCARDED — a resume
+                    # regenerates them exactly, a cancel never emits them
+                    clean = False
+                    continue
+                n = int(ems[lane].sum())   # prefix mask: first n are valid
+                if n == 0:
+                    continue
+                emitted_total += n
+                # the block is one device round trip: spread its wall time
+                # evenly over the lane's tokens so ITL keeps a true mean
+                # (the burst shape is documented in docs/PERFORMANCE.md)
+                dt = (now - req.t_last) / n if req.t_last is not None \
+                    else None
+                for j in range(n):
+                    tok = int(toks[lane, j])
+                    req.length += 1
+                    req.tokens_out.append(tok)
+                    self.tokens_generated += 1
+                    if self.metrics is not None and dt is not None:
+                        self.metrics.observe_itl(dt)
+                    lp = float(lps[lane, j]) if req.want_logprobs else None
+                    if req.want_logprobs:
+                        req.logprobs_out.append(lp)
+                    emits.append((req, tok, len(req.tokens_out) - 1, lp))
+                req.t_last = now
+                self._flush_decode_chunk(req, lane, now, block=k)
+                if req.finished():
+                    self._release_lane_locked(lane, req)
+                    completed.append(req)
+            self._admit_locked()
+        if self.trace is not None and emitted_total:
+            self.trace.add_counter("decode_block", now,
+                                   tokens=emitted_total, k=k)
+        # dispatch-ahead: with the lane set stable (nothing finished, no
+        # cancel/preempt observed) and the SAME adaptive K still the right
+        # choice, enqueue block N+1 from the device-resident carry BEFORE
+        # running block N's callbacks — the next block computes while the
+        # host emits.  Correctness never depends on this: a request
+        # released between dispatch and consume has its block discarded
+        # above, and its stale device writes only touch positions a new
+        # page owner rewrites before reading.
+        if (clean and not completed and k > 1
+                and self._pending_block is None and not self._shutdown):
+            lanes_now = list(stash["lane_reqs"].items())
+            if self._pick_block_k(lanes_now) == k:
+                k2, parts2 = self._reserve_block_pages(lanes_now, k)
+                if k2 == k and len(parts2) == len(lanes_now):
+                    self._pending_block = self._dispatch_block(
+                        parts2, k, jnp, carry=stash["carry"],
+                        host=stash["host"])
+                # else: pages stay reserved on the lanes for the next
+                # regular plan (bounded hoard: <= one block per lane)
+        # user callbacks and future resolution OUTSIDE the scheduler lock:
+        # a slow consumer must not head-of-line-block other lanes
+        for req, tok, i, lp in emits:
+            self._emit(req, tok, i, lp)
+        for req in completed:
+            if not req.future.done():
+                req.future.set_result(self._result_of(req))
+                self.completed_requests += 1
+                self._note_complete(req)
+        return True
+
+    def _tick_single(self, parts, jnp) -> bool:
+        """K=1 decode tick (host-sampled lanes present, or decode_block=1):
+        one dispatch + one fetch per token, the pre-block behavior."""
+        b = self.lanes
+        tables = np.zeros((b, self.max_pages), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        tokens = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        # device-sampled lanes carry their temperature into the step (the
+        # tick then fetches only (B,)-sized arrays for them); host-sampled
+        # (top_k/top_p) lanes keep temp 0 on device and pick from fetched
+        # logits rows
+        temps = np.zeros((b,), np.float32)
+        seeds = np.zeros((b, 2), np.uint32)   # (lo, hi) words
+        host_lanes = []
+        want_logp = False
+        lane_reqs = {}
+        for lane, req, _new in parts:
+            lane_reqs[lane] = req
             tokens[lane] = req.tokens_out[-1]
             tables[lane, :len(req.pages)] = req.pages
             lengths[lane] = req.length
             active[lane] = True
-
-        if not active.any():
-            return False
-        # chaos: decode-tick fault site — an error fails the in-flight
-        # requests and resets the pool (the scheduler's recovery path); a
-        # delay makes every lane's step slow (deadline-storm scenarios)
-        chaos.trip("engine.step")
-        # device-sampled lanes carry their temperature into the step (the
-        # tick then fetches only (B,) token ids for them); host-sampled
-        # (top_k) lanes keep temp 0 on device and pick from fetched logits
-        temps = np.zeros((self.lanes,), np.float32)
-        seeds = np.zeros((self.lanes, 2), np.uint32)   # (lo, hi) words
-        host_lanes = []
-        want_logp = False
-        for lane, req in enumerate(snapshot):
-            if req is None or not active[lane]:
-                continue
             want_logp |= req.want_logprobs
             sp = req.sampling
             if sp.temperature > 0.0:
@@ -1349,6 +1728,11 @@ class ContinuousBatcher:
                                    (sp.seed >> 32) & 0xFFFFFFFF)
                 else:
                     host_lanes.append(lane)
+        # chaos: decode-tick fault site — an error fails the in-flight
+        # requests and resets the pool (the scheduler's recovery path); a
+        # delay makes every lane's step slow (deadline-storm scenarios)
+        chaos.trip("engine.step")
+        t0 = _time.perf_counter()
         logprobs_arr = None
         if temps.any() or want_logp:
             tok_dev, logp_dev, logits, self.pool.kv = self._step(
@@ -1369,19 +1753,25 @@ class ContinuousBatcher:
                 jnp.asarray(tables), jnp.asarray(lengths),
                 jnp.asarray(tokens), jnp.asarray(active))
             next_tokens = np.asarray(logits.argmax(-1), np.int32).copy()
+        self.decode_dispatches += 1
+        self.decode_host_syncs += 1
         if host_lanes:
-            logits_host = np.asarray(logits)
-            # only active host-sampled lanes consume PRNG state: a
+            # fetch ONLY the host-sampled rows: gather them device-side,
+            # then one (n_host, vocab) transfer — not the full
+            # (lanes, vocab) matrix when a single lane host-samples.
+            # Only active host-sampled lanes consume PRNG state: a
             # page-starved or pending-prefill lane must not perturb a
             # seeded request's token sequence (per-request reproducibility)
-            for lane in host_lanes:
-                next_tokens[lane] = snapshot[lane].sampling.pick(
-                    logits_host[lane])
+            rows = np.asarray(
+                logits[jnp.asarray(np.asarray(host_lanes, np.int32))])
+            self.decode_host_syncs += 1
+            for i, lane in enumerate(host_lanes):
+                next_tokens[lane] = lane_reqs[lane].sampling.pick(rows[i])
                 if logprobs_arr is not None:
                     # f32 log-sum-exp: the same precision class as the
                     # device log_softmax used for prefill and for
                     # device-sampled lanes — one request, one precision
-                    row = logits_host[lane].astype(np.float32)
+                    row = rows[i].astype(np.float32)
                     row = row - row.max()
                     logprobs_arr[lane] = float(
                         row[next_tokens[lane]]
@@ -1391,14 +1781,12 @@ class ContinuousBatcher:
         completed: List = []
         now = _time.perf_counter()  # post-fetch: the tick's device work is
         #                             done, so per-lane deltas are real
+        self._step_ewma_s = (0.8 * self._step_ewma_s + 0.2 * (now - t0)
+                             if self._step_ewma_s else now - t0)
         with self._cv:
-            for lane, req in enumerate(snapshot):
-                if req is None:
-                    continue
+            for lane, req in lane_reqs.items():
                 if req.cancelled:
                     continue  # the _run sweep releases it next round
-                if not active[lane]:
-                    continue
                 req.length += 1
                 req.tokens_out.append(int(next_tokens[lane]))
                 self.tokens_generated += 1
@@ -1572,6 +1960,79 @@ def benchmark_decode_kernel_sweep(
             # the shorter contexts (the 16k point is one geometry)
             autotune=ctx <= 8192))
     return rows
+
+
+def benchmark_decode_dispatch(ks=(1, 4, 8, 16), lanes: int = 4,
+                              steps: int = 48, prompt_len: int = 8,
+                              d_model: int = 64, n_heads: int = 4,
+                              n_layers: int = 2, vocab: int = 256,
+                              dtype=None) -> Dict[str, Any]:
+    """Served tokens/s and host-sync accounting of the ContinuousBatcher
+    across fused-decode block sizes K (the bench ``decode_dispatch`` row).
+
+    The same submit->result workload runs at each K; per K the row
+    records tok/s, decode dispatches, blocking host syncs, and
+    syncs-per-token, plus greedy token parity against the K=1 run.  On
+    CPU jit the dispatch/sync counts are the signal (there is no link
+    RTT to amortize); on-device the tok/s uplift is — off-chip, the
+    per-token cost IS the round trip, so tok/s should scale toward the
+    kernel rate as K grows.
+    """
+    import time
+
+    import jax.numpy as jnp
+
+    from tpulab.models.transformer import init_transformer_params
+
+    dtype = dtype or jnp.float32
+    params = init_transformer_params(vocab=vocab, d_model=d_model,
+                                     n_heads=n_heads, n_layers=n_layers,
+                                     d_ff=4 * d_model)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, vocab, (prompt_len,), np.int32)
+               for _ in range(lanes)]
+    max_len = prompt_len + steps + 8
+    row: Dict[str, Any] = {"lanes": lanes, "steps": steps, "k": {}}
+    base_tokens = None
+    for k in ks:
+        cb = ContinuousBatcher(params, n_heads=n_heads, n_layers=n_layers,
+                               lanes=lanes, max_len=max_len, page_size=8,
+                               compute_dtype=dtype, decode_block=k)
+        try:
+            # warm the prefill/decode compiles out of the measurement
+            for f in [cb.submit(p, steps) for p in prompts]:
+                f.result(timeout=600)
+            d0, s0 = cb.decode_dispatches, cb.decode_host_syncs
+            tg0 = cb.tokens_generated
+            t0 = time.perf_counter()
+            futs = [cb.submit(p, steps) for p in prompts]
+            outs = [list(f.result(timeout=600)) for f in futs]
+            dt = time.perf_counter() - t0
+            toks = cb.tokens_generated - tg0
+            entry = {
+                "tok_s": round(toks / max(dt, 1e-9), 1),
+                "dispatches": cb.decode_dispatches - d0,
+                "host_syncs": cb.decode_host_syncs - s0,
+                "syncs_per_token": round(
+                    (cb.decode_host_syncs - s0) / max(toks, 1), 4),
+            }
+            if base_tokens is None:
+                base_tokens = outs
+            else:
+                entry["parity_vs_k1"] = outs == base_tokens
+            row["k"][str(k)] = entry
+        except Exception as e:  # one K's failure must not sink the row
+            row["k"][str(k)] = {
+                "error": f"{type(e).__name__}: {str(e)[:160]}"}
+        finally:
+            cb.shutdown()
+    k1 = row["k"].get("1", {})
+    best = max((e for e in row["k"].values() if "tok_s" in e),
+               key=lambda e: e["tok_s"], default=None)
+    if best is not None and k1.get("tok_s"):
+        row["best_tok_s"] = best["tok_s"]
+        row["uplift_vs_k1"] = round(best["tok_s"] / k1["tok_s"], 3)
+    return row
 
 
 def benchmark_llm_decode(n_heads: int = 16, n_kv_heads: int = 4,

@@ -311,6 +311,24 @@ class GenerationMetrics:
             f"{ns}_llm_deadline_expired_total",
             "Requests the batcher cancelled at deadline expiry",
             registry=self.registry)
+        # -- fused-decode dispatch efficiency (multi-step decode blocks) ----
+        self.decode_dispatches = Counter(
+            f"{ns}_llm_decode_dispatches",
+            "Fused decode dispatches (K-token blocks and single ticks)",
+            registry=self.registry)
+        self.decode_host_syncs = Counter(
+            f"{ns}_llm_decode_host_syncs",
+            "Blocking device->host result fetches in decode",
+            registry=self.registry)
+        self.tokens_per_dispatch = Gauge(
+            f"{ns}_llm_tokens_per_dispatch",
+            "Generated tokens per decode dispatch (lifetime ratio; ~K x "
+            "lanes when fused blocks run full)", registry=self.registry)
+        self.host_syncs_per_token = Gauge(
+            f"{ns}_llm_host_syncs_per_token",
+            "Blocking host syncs per generated token (1.0 = per-token "
+            "round trips; ~1/(K*lanes) under fused decode)",
+            registry=self.registry)
         self._ttft_res = _Reservoir()
         self._itl_res = _Reservoir()
         self._last: Dict[str, int] = {}
@@ -363,6 +381,18 @@ class GenerationMetrics:
         self._advance(self.completed, "completed",
                       batcher.completed_requests)
         self._advance(self.preemptions, "preempt", batcher.preemptions)
+        # fused-decode dispatch efficiency (getattr: wrapped engines may
+        # not expose the counters)
+        dispatches = getattr(batcher, "decode_dispatches", 0)
+        syncs = getattr(batcher, "decode_host_syncs", 0)
+        self._advance(self.decode_dispatches, "dispatches", dispatches)
+        self._advance(self.decode_host_syncs, "syncs", syncs)
+        if dispatches:
+            self.tokens_per_dispatch.set(
+                batcher.tokens_generated / dispatches)
+        if batcher.tokens_generated:
+            self.host_syncs_per_token.set(
+                syncs / batcher.tokens_generated)
         pc = getattr(batcher, "prefix_cache", None)
         if pc is not None:
             self._advance(self.prefix_hits, "hits", pc.hits)

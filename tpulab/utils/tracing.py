@@ -186,6 +186,19 @@ class ChromeTraceRecorder:
         with self._lock:
             self._events.append(ev)
 
+    def add_counter(self, name: str, ts_s: float, **values) -> None:
+        """One counter ('C') sample; ``ts_s`` is a time.perf_counter value
+        from the same process.  Perfetto/chrome render each name as a
+        stacked counter track — the batcher samples ``decode_block``
+        (tokens delivered + block size K per fused dispatch) so the
+        tokens-per-dispatch shape is visible on the same timeline as the
+        request spans it explains."""
+        ev = {"name": name, "ph": "C", "pid": self._pid, "tid": 0,
+              "ts": round((ts_s - self._t0) * 1e6, 3),
+              "args": {k: float(v) for k, v in values.items()}}
+        with self._lock:
+            self._events.append(ev)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._events)
