@@ -825,6 +825,23 @@ def main() -> None:
     except Exception as e:
         print(f"# decode dispatch row skipped: {e!r}", file=sys.stderr)
 
+    # tiered KV cache (docs/PERFORMANCE.md "KV tiering"): the same
+    # preemption-heavy workload under ~2x KV oversubscription with the
+    # host-memory offload tier on vs off.  The claim tracked: with the
+    # tier on, preemptions swap instead of recompute — re-prefill
+    # dispatches collapse toward zero.  On CPU jit the dispatch counts
+    # are the signal; on-device every avoided re-prefill is a full
+    # prompt+generated forward not burned twice, so goodput is the
+    # headline there.
+    _phase("kv_offload")
+    try:
+        from tpulab.kvcache import benchmark_kv_offload
+        _record(kv_offload=benchmark_kv_offload(
+            n_low=2 if degraded else 4, n_hi=2 if degraded else 4,
+            steps=12 if degraded else 20))
+    except Exception as e:
+        print(f"# kv offload row skipped: {e!r}", file=sys.stderr)
+
     # admission control under overload (docs/SERVING.md): offer ~2x the
     # measured capacity with per-request deadlines and record goodput
     # (deadline-met completions/s), shed rate, and p99 admission queue

@@ -11,6 +11,8 @@ Layer map (mirrors reference trtlab/CMakeLists.txt:2-19 layering):
     tpulab.core      host runtime (pools, thread pools, batcher, affinity)
     tpulab.tpu       device layer (topology, sync, host<->HBM staging)
     tpulab.engine    executable runtime (Runtime/Model/InferenceManager/...)
+    tpulab.kvcache   tiered KV cache: host-memory offload tier (swap,
+                     recompute-free preemption, spill-backed prefix cache)
     tpulab.rpc       async gRPC microservice framework
     tpulab.serving   admission control & QoS frontend (docs/SERVING.md)
     tpulab.models    model zoo (ResNet, MNIST, transformer) in pure JAX

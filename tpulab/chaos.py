@@ -53,6 +53,10 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
     engine.step               ContinuousBatcher tick + GenerationSession.step
     engine.prefill            ContinuousBatcher fused prefill
     device.transfer           Bindings.copy_to_device (host->device staging)
+    kvcache.swap              KVOffloadManager swap-out/restore/demote/
+                              promote — error/drop degrade that swap to the
+                              pre-offload recompute path (the lane/entry is
+                              never corrupted, work is just recomputed)
 """
 
 from __future__ import annotations

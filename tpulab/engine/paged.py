@@ -542,6 +542,15 @@ class PrefixCache:
         self._entries: "OrderedDict[bytes, int]" = OrderedDict()
         self.hits = 0       # pages served from cache
         self.misses = 0     # full prompt pages computed fresh
+        #: optional host-tier hooks (set by the batcher when kv_offload is
+        #: on): ``on_evict(digest, page)`` fires on pressure eviction
+        #: BEFORE the page is released (demotion window);
+        #: ``promote_fn(digest) -> Optional[page]`` may resurrect a
+        #: demoted entry during lookup — the returned page's single pool
+        #: reference belongs to the cache.
+        self.on_evict = None
+        self.promote_fn = None
+        self.host_promotions = 0  # lookup pages served from the host tier
 
     @staticmethod
     def _digests(prompt: np.ndarray, page_size: int, n_pages: int):
@@ -573,6 +582,14 @@ class PrefixCache:
         shared: List[int] = []
         for i in range(cacheable):
             page = self._entries.get(digests[i])
+            if page is None and self.promote_fn is not None:
+                # spill-backed cache: a demoted entry can come back from
+                # the host tier mid-lookup (the hook allocates + uploads;
+                # the new page's one ref is the cache's)
+                page = self.promote_fn(digests[i])
+                if page is not None:
+                    self._entries[digests[i]] = page
+                    self.host_promotions += 1
             if page is None:
                 break
             self._entries.move_to_end(digests[i])
@@ -613,6 +630,16 @@ class PrefixCache:
         for dig, page in self._entries.items():  # OrderedDict: cold first
             if self._pool.refcount(page) == 1:
                 del self._entries[dig]
+                if self.on_evict is not None:
+                    # demotion window: the hook's device-side copy is
+                    # dispatched before the release below, so a recycled
+                    # page's later writes are stream-ordered after it
+                    try:
+                        self.on_evict(dig, page)
+                    except Exception:  # demotion is best-effort
+                        import logging
+                        logging.getLogger("tpulab.engine").exception(
+                            "prefix-cache demotion hook failed")
                 self._pool.release_pages([page])
                 return True
         return False
@@ -707,7 +734,7 @@ class _PagedRequest:
                  "sampling", "priority", "resumed", "admit_seq",
                  "stop_tokens", "want_logprobs", "logprobs_out", "deadline",
                  "trace_id", "t_submit", "t_prefill0", "t_first", "t_last",
-                 "chunk_t0", "chunk_start")
+                 "chunk_t0", "chunk_start", "kv_handle")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
                  sampling: Optional[SamplingParams] = None,
@@ -727,6 +754,9 @@ class _PagedRequest:
         self.priority = priority
         self.resumed = False     # preempted mid-decode; resume skips the
         #                          prefill pick (its token was already emitted)
+        self.kv_handle = None    # host-tier KV snapshot of a preempted lane
+        #                          (kvcache.SwapHandle); resume swaps it back
+        #                          in instead of re-prefilling
         self.admit_seq = -1      # admission order (preemption tie-break)
         self.stop_tokens = frozenset(int(t) for t in (stop_tokens or ()))
         self.want_logprobs = logprobs
@@ -776,6 +806,13 @@ class ContinuousBatcher:
     regress; per-token ``on_token`` callbacks still fire in order, and
     cancellation/deadline sweeps act at block boundaries (a request stops
     within at most one block of the sweep observing it).
+
+    Tiered KV (``kv_offload=``, tpulab.kvcache): preemption swaps the
+    victim's KV pages to a budgeted host-RAM tier (async, write-behind)
+    and resume swaps them back with ZERO prefill dispatches; prefix-cache
+    entries evicted under pool pressure demote to the host tier and
+    promote back on the next lookup hit.  Every degraded swap falls back
+    to the exact re-prefill/recompute path — see docs/PERFORMANCE.md.
     """
 
     #: explicit capability marker for routers (e.g. the Generate RPC)
@@ -807,7 +844,8 @@ class ContinuousBatcher:
                  kv_dtype=None,
                  prefill_flash: Optional[bool] = None,
                  trace=None, metrics=None,
-                 decode_block: int = 8):
+                 decode_block: int = 8,
+                 kv_offload=None):
         import jax
         import jax.numpy as jnp
 
@@ -906,6 +944,28 @@ class ContinuousBatcher:
                     rope_theta=rope_theta),
             donate_argnums=(1,))
         self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
+        # host-memory KV tier (tpulab.kvcache): None/False = off (zero
+        # cost); True = a manager with the default host budget; an int =
+        # budget bytes; a KVOffloadManager = bring-your-own (shared
+        # store/transfer).  When on, preemption swaps KV device->host and
+        # resume swaps back (no re-prefill), and prefix-cache eviction
+        # demotes to / promotes from the host tier.
+        self._owns_offload = False
+        if kv_offload is None or kv_offload is False:
+            self.kv_offload = None
+        else:
+            from tpulab.kvcache import (DEFAULT_HOST_BUDGET,
+                                        KVOffloadManager)
+            if isinstance(kv_offload, KVOffloadManager):
+                self.kv_offload = kv_offload
+            else:
+                budget = (DEFAULT_HOST_BUDGET if kv_offload is True
+                          else int(kv_offload))
+                self.kv_offload = KVOffloadManager(self.pool, budget)
+                self._owns_offload = True
+        if self.kv_offload is not None and self.prefix_cache is not None:
+            self.prefix_cache.on_evict = self._demote_prefix
+            self.prefix_cache.promote_fn = self._promote_prefix
         if prefill_chunk is not None:
             if prefill_chunk < page_size:
                 raise ValueError("prefill_chunk must be >= page_size")
@@ -1012,6 +1072,7 @@ class ContinuousBatcher:
                 if req in self._queue:  # never started: finish immediately
                     self._queue.remove(req)
                     self._requests.pop(future, None)
+                    self._discard_handle(req)
         if req is not None and req not in self._active and not future.done():
             future.cancel()
 
@@ -1021,7 +1082,10 @@ class ContinuousBatcher:
             self._cv.notify()
         self._thread.join(timeout=30)
         if not self._thread.is_alive() and self.prefix_cache is not None:
+            self.prefix_cache.on_evict = None  # shutdown clear != pressure
             self.prefix_cache.clear()  # release the cache's page refs
+        if self._owns_offload and not self._thread.is_alive():
+            self.kv_offload.close()  # drain write-behind, free host tier
         if self._owns_pool and not self._thread.is_alive():
             self.pool.close()  # free the page stores' HBM eagerly
 
@@ -1078,11 +1142,34 @@ class ContinuousBatcher:
 
     def _alloc_page(self) -> Optional[int]:
         """Pool page, evicting cold prefix-cache entries under pressure —
-        live requests always outrank cached prefixes."""
+        live requests always outrank cached prefixes (with kv_offload the
+        eviction DEMOTES the entry to the host tier instead of losing it)."""
         page = self.pool.allocate_page()
         while (page is None and self.prefix_cache is not None
                and self.prefix_cache.evict_for_alloc()):
             page = self.pool.allocate_page()
+        return page
+
+    # -- host KV tier (kv_offload) -------------------------------------------
+    def _demote_prefix(self, digest: bytes, page: int) -> None:
+        """PrefixCache.on_evict hook: spill the evicted page host-side."""
+        self.kv_offload.demote(digest, page, self.pool.kv)
+
+    def _promote_prefix(self, digest: bytes) -> Optional[int]:
+        """PrefixCache.promote_fn hook: resurrect a demoted entry into a
+        fresh pool page (plain allocate — promotion must not evict OTHER
+        device entries and thrash the cache against itself)."""
+        mgr = self.kv_offload
+        if not mgr.has_prefix(digest):
+            return None
+        page = self.pool.allocate_page()
+        if page is None:
+            return None
+        new_kv = mgr.promote(digest, page, self.pool.kv)
+        if new_kv is None:
+            self.pool.release_pages([page])
+            return None
+        self.pool.kv = new_kv
         return page
 
     def _admit_to_lane_locked(self, lane: int) -> bool:
@@ -1135,8 +1222,21 @@ class ContinuousBatcher:
         """Evict the lane's request: free its pages now, re-queue it for an
         exact-token resume (re-prefill of prompt+generated; no sampling
         PRNG draws are consumed on resume, so seeded sequences are
-        unchanged by preemption)."""
+        unchanged by preemption).  With ``kv_offload`` the lane's live KV
+        pages are first snapshotted device->host (async write-behind —
+        only the gather dispatch happens here); the resume then swaps
+        them back in with zero prefill dispatches, and the re-prefill
+        below becomes the FALLBACK for a failed/dropped swap."""
         req = self._active[lane]
+        if self.kv_offload is not None and req.length > 0:
+            t_sw0 = _time.perf_counter()
+            needed = (req.length + self.page_size - 1) // self.page_size
+            req.kv_handle = self.kv_offload.swap_out(
+                req.pages[:needed], req.length, self.pool.kv)
+            if req.kv_handle is not None:
+                self._span("swap_out", lane, t_sw0,
+                           _time.perf_counter() - t_sw0, req,
+                           pages=needed, tokens=req.length)
         self.pool.release_pages(req.pages)
         req.pages = []
         if req.tokens_out:
@@ -1183,6 +1283,7 @@ class ContinuousBatcher:
                         if (req.deadline is not None
                                 and now >= req.deadline):
                             self._requests.pop(req.future, None)
+                            self._discard_handle(req)
                             expired.append(req)
                         else:
                             still.append(req)
@@ -1253,6 +1354,15 @@ class ContinuousBatcher:
         if req.cancelled or req.length != 0:  # swept / already started
             return False
         t = len(req.pending_prompt)
+        if req.kv_handle is not None:
+            # recompute-free resume: swap the preemption snapshot back in
+            # instead of re-prefilling.  True = restored (zero prefill
+            # dispatches); False = page-starved (handle kept, retry next
+            # pass); None = swap degraded (handle consumed, fall through
+            # to the exact re-prefill below — today's path)
+            swapped = self._try_swap_in(req, t, lane)
+            if swapped is not None:
+                return swapped
         prompt = np.asarray(req.pending_prompt, np.int32)
         shared: List[int] = []
         digests: List[bytes] = []
@@ -1394,6 +1504,53 @@ class ContinuousBatcher:
             self.prefix_cache.count_lookup(len(shared), len(digests))
             self.prefix_cache.insert(digests, req.pages[:len(digests)])
         return True
+
+    def _try_swap_in(self, req: _PagedRequest, t: int,
+                     lane: int) -> Optional[bool]:
+        """Restore a preempted lane's host-tier KV snapshot into freshly
+        allocated pages (see _do_prefill for the tri-state contract).
+        ``t`` is the resume length — by construction equal to the
+        snapshot's covered positions (prompt + generated - 1)."""
+        handle = req.kv_handle
+        needed = handle.n_pages
+        while len(req.pages) < needed:
+            page = self._alloc_page()
+            if page is None:
+                # page pressure: release partial holdings (no hold-and-
+                # wait), KEEP the handle — the snapshot outlives retries
+                self.pool.release_pages(req.pages)
+                req.pages = []
+                return False
+            req.pages.append(page)
+        t0 = _time.perf_counter()
+        new_kv = self.kv_offload.restore(handle, req.pages[:needed],
+                                         self.pool.kv)
+        req.kv_handle = None
+        if new_kv is None:
+            # degraded swap: hand the pages back and run the normal
+            # re-prefill (which re-does prefix lookup and its own page
+            # accounting from a clean slate)
+            self.pool.release_pages(req.pages)
+            req.pages = []
+            return None
+        self.pool.kv = new_kv
+        req.length = t
+        req.pending_prompt = []
+        req.resumed = False  # the first-token pick happened pre-preemption
+        now = _time.perf_counter()
+        self._span("swap_in", lane, t0, now - t0, req,
+                   pages=needed, tokens=t)
+        req.chunk_t0 = now        # decode chunks restart here
+        req.chunk_start = len(req.tokens_out)
+        return True
+
+    def _discard_handle(self, req: _PagedRequest) -> None:
+        """Drop a never-to-be-restored snapshot (cancel/expiry while
+        queued) so it stops holding host-tier budget."""
+        if req.kv_handle is not None:
+            if self.kv_offload is not None:
+                self.kv_offload.discard(req.kv_handle)
+            req.kv_handle = None
 
     @staticmethod
     def _emit(req: _PagedRequest, token: int, index: int,
@@ -1827,6 +1984,7 @@ class ContinuousBatcher:
 
     def _release_lane_locked(self, lane: int, req: _PagedRequest) -> None:
         self.pool.release_pages(req.pages)
+        self._discard_handle(req)  # a cancelled resume never restores
         self._active[lane] = None
         self._requests.pop(req.future, None)
 

@@ -1,0 +1,441 @@
+"""KV offload manager: device<->host tiering policy over the paged pool.
+
+HBM pressure in the serving stack used to destroy state: a preempted
+request re-prefilled prompt+generated from scratch, an evicted prefix
+cache entry was simply gone.  This module turns both into *demotions* to
+a host-RAM tier (:class:`~tpulab.kvcache.host_store.HostKVStore`) and
+back:
+
+- **Preemption** — :meth:`KVOffloadManager.swap_out` snapshots the
+  victim lane's live KV pages device->host *asynchronously* (device-side
+  gather dispatched inline, the host fetch rides the
+  :class:`~tpulab.tpu.transfer.TransferEngine` collector thread — the
+  decode tick never blocks on swap-out: write-behind).  On resume,
+  :meth:`restore` scatters the snapshot into freshly allocated pages and
+  the request continues decoding with ZERO prefill dispatches.
+- **Prefix-cache eviction** — :meth:`demote` moves an evicted entry's
+  page to the host tier keyed by its prompt digest; :meth:`promote`
+  brings it back on the next lookup hit, making the prefix cache's
+  effective capacity host-RAM-sized.
+
+Every degraded path is the pre-offload behavior: a snapshot that was
+dropped (budget), failed (transfer error) or chaos-tripped
+(``kvcache.swap``) simply leaves the request on today's
+re-prefill/recompute path — offload can only *save* work, never corrupt
+a lane.
+
+Ordering safety: the gather that snapshots pages is dispatched BEFORE
+the pages are released, and XLA executes a device's programs in
+dispatch order — any later write into a recycled page is ordered after
+the gather's read, so the snapshot observes the victim's bytes even
+though the fetch completes later.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from tpulab import chaos
+from tpulab.kvcache.host_store import HostKVStore
+
+log = logging.getLogger("tpulab.kvcache")
+
+#: default host-tier budget (bytes) when ``kv_offload=True``-style knobs
+#: construct the manager implicitly
+DEFAULT_HOST_BUDGET = 256 << 20
+
+#: swap-handle states
+_PENDING, _RESIDENT, _DROPPED, _FAILED = range(4)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+class SwapHandle:
+    """One lane snapshot's lifecycle token.  Returned by ``swap_out``;
+    consumed by ``restore``.  ``wait()`` is the write-behind fence —
+    True once the snapshot is resident in the host tier."""
+
+    __slots__ = ("key", "n_pages", "length", "_done", "_state")
+
+    def __init__(self, key, n_pages: int, length: int):
+        self.key = key
+        self.n_pages = n_pages
+        self.length = length            # resident positions the snapshot covers
+        self._done = threading.Event()
+        self._state = _PENDING
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """True when the snapshot landed in the host tier; False while
+        still in flight (timeout) or when it was dropped/failed."""
+        self._done.wait(timeout)
+        return self._state == _RESIDENT
+
+    @property
+    def resident(self) -> bool:
+        return self._state == _RESIDENT
+
+
+class KVOffloadManager:
+    """Device<->host KV tiering for one :class:`PagedKVPool` (module
+    docstring).  ``transfer`` is an optional shared
+    :class:`~tpulab.tpu.transfer.TransferEngine` (one is owned
+    otherwise); ``metrics`` an optional
+    :class:`~tpulab.utils.metrics.KVTierMetrics` observing swap
+    latency/bytes at the source.
+    """
+
+    #: bound on how long a resume waits for its write-behind snapshot to
+    #: land before falling back to re-prefill (the snapshot is normally
+    #: resident long before the victim reaches the queue head)
+    RESTORE_WAIT_S = 10.0
+
+    def __init__(self, pool, host_budget_bytes: int = DEFAULT_HOST_BUDGET,
+                 store: Optional[HostKVStore] = None, transfer=None,
+                 metrics=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.pool = pool
+        self.store = store or HostKVStore(host_budget_bytes)
+        if transfer is None:
+            from tpulab.tpu.transfer import TransferEngine
+            transfer = TransferEngine(name="kvswap")
+            self._owns_transfer = True
+        else:
+            self._owns_transfer = False
+        self._transfer = transfer
+        self.metrics = metrics
+        # per-page payload size: pool store is (L, P, 2, S, Hkv, D); one
+        # page carries every layer's K+V rows for its S slots
+        shape = tuple(pool.kv.shape)
+        self.page_nbytes = int(np.prod(shape) // shape[1]
+                               * jnp.dtype(pool.dtype).itemsize)
+        # page-index gathers/scatters, padded to pow2 page counts so the
+        # jit cache stays at log2 variants (padding rides the RESERVED
+        # scratch page 0: reads of it are discarded, writes to it are
+        # harmless by the pool's own contract)
+        self._gather = jax.jit(lambda kv, idx: kv[:, idx])
+        self._scatter = jax.jit(lambda kv, idx, data: kv.at[:, idx].set(data),
+                                donate_argnums=(0,))
+        self._lock = threading.Lock()
+        self._ops_cv = threading.Condition(self._lock)
+        self._seq = 0
+        self._pending_ops = 0   # write-behind copies still in flight
+        # -- counters (KVTierMetrics.poll advances from these) --------------
+        self.swap_outs = 0              # lane snapshots dispatched
+        self.swap_ins = 0               # lane snapshots restored
+        self.swap_out_bytes = 0
+        self.swap_in_bytes = 0
+        self.swap_failures = 0          # chaos/transfer/budget degradations
+        self.demotions = 0              # prefix pages demoted to host
+        self.promotions = 0             # prefix pages promoted back
+        self.recompute_tokens_saved = 0  # prefill tokens resumes skipped
+
+    # -- lane swap (preemption) ----------------------------------------------
+    def swap_out(self, pages: List[int], length: int, kv
+                 ) -> Optional[SwapHandle]:
+        """Snapshot ``pages`` (covering positions ``[0, length)``) to the
+        host tier.  Dispatches the device gather and returns immediately;
+        the D2H fetch + store happen behind the decode loop (write-
+        behind).  None = degraded (chaos/failure): caller keeps today's
+        drop-and-re-prefill path."""
+        if not pages or length <= 0:
+            return None
+        try:
+            if chaos.trip("kvcache.swap") == "drop":
+                raise chaos.ChaosError("injected swap drop")
+            n = len(pages)
+            idx = np.zeros((_next_pow2(n),), np.int32)  # pad -> scratch 0
+            idx[:n] = pages
+            gathered = self._gather(kv, idx)
+        except Exception as e:  # noqa: BLE001 - degrade, never corrupt
+            self.swap_failures += 1
+            log.warning("KV swap-out degraded to recompute path: %s: %s",
+                        type(e).__name__, str(e)[:200])
+            return None
+        with self._lock:
+            self._seq += 1
+            handle = SwapHandle(("lane", self._seq), n, length)
+            self._pending_ops += 1
+        t0 = _time.perf_counter()
+        fut = self._transfer.fetch(gathered)
+        fut.add_done_callback(
+            lambda f: self._on_fetched(handle, f, n, t0, ("lane",)))
+        return handle
+
+    def _on_fetched(self, handle: SwapHandle, fut, n: int, t0: float,
+                    kind) -> None:
+        """TransferEngine-thread completion: land the snapshot in the host
+        tier (the future itself is dropped afterwards, so the only host
+        copy is the budgeted one)."""
+        try:
+            arr = np.asarray(fut.result())[:, :n]  # strip pow2 padding
+            stored = self.store.put(handle.key, arr)
+        except Exception:  # noqa: BLE001 - collector thread must live
+            handle._state = _FAILED
+            self.swap_failures += 1
+            log.exception("KV swap-out fetch failed")
+        else:
+            if stored:
+                handle._state = _RESIDENT
+                self.swap_outs += 1
+                self.swap_out_bytes += arr.nbytes
+                if self.metrics is not None:
+                    self.metrics.observe_swap_out(
+                        _time.perf_counter() - t0, arr.nbytes)
+            else:
+                handle._state = _DROPPED
+                self.swap_failures += 1
+        finally:
+            handle._done.set()
+            with self._ops_cv:
+                self._pending_ops -= 1
+                self._ops_cv.notify_all()
+
+    def restore(self, handle: SwapHandle, pages: List[int], kv):
+        """Scatter ``handle``'s snapshot into ``pages`` (freshly allocated
+        by the caller, same count).  Returns the new donated pool buffer,
+        or None when the snapshot is unavailable (still in flight past
+        :data:`RESTORE_WAIT_S`, dropped, failed, or chaos-tripped) — the
+        caller then re-prefills exactly as before offload existed.
+
+        Degradation boundary: every failure BEFORE the scatter dispatch
+        returns None with ``kv`` untouched.  A failure in the scatter
+        itself propagates — the donated buffer is gone and the scheduler's
+        pool-reset recovery path must run, same as any failed step."""
+        import jax
+
+        t0 = _time.perf_counter()
+        try:
+            if chaos.trip("kvcache.swap") == "drop":
+                raise chaos.ChaosError("injected swap drop")
+            if not handle.wait(self.RESTORE_WAIT_S):
+                raise chaos.ChaosError("snapshot unavailable")
+            arr = self.store.pop(handle.key)
+            if arr is None or len(pages) != handle.n_pages:
+                raise chaos.ChaosError("snapshot evicted from host tier")
+            n = handle.n_pages
+            idx = np.zeros((_next_pow2(n),), np.int32)  # pad -> scratch 0
+            idx[:n] = pages
+            if n != idx.shape[0]:
+                pad = np.repeat(arr[:, -1:], idx.shape[0] - n, axis=1)
+                arr = np.concatenate([arr, pad], axis=1)
+            data = jax.device_put(arr, self.pool.device)
+        except Exception as e:  # noqa: BLE001 - pre-dispatch: degrade
+            self.swap_failures += 1
+            self.store.remove(handle.key)
+            log.warning("KV swap-in degraded to re-prefill: %s: %s",
+                        type(e).__name__, str(e)[:200])
+            return None
+        new_kv = self._scatter(kv, idx, data)
+        self.swap_ins += 1
+        self.swap_in_bytes += handle.n_pages * self.page_nbytes
+        self.recompute_tokens_saved += handle.length
+        if self.metrics is not None:
+            self.metrics.observe_swap_in(
+                _time.perf_counter() - t0,
+                handle.n_pages * self.page_nbytes)
+        return new_kv
+
+    def discard(self, handle: SwapHandle) -> None:
+        """Forget a snapshot that will never be restored (request
+        cancelled/expired while queued)."""
+        self.store.remove(handle.key)
+
+    # -- prefix-cache tiering ------------------------------------------------
+    def demote(self, digest: bytes, page: int, kv) -> None:
+        """Async-copy one evicted prefix page to the host tier (called by
+        the cache's eviction path BEFORE the page is released — dispatch
+        order makes the snapshot safe, see module docstring)."""
+        try:
+            if chaos.trip("kvcache.swap") == "drop":
+                raise chaos.ChaosError("injected swap drop")
+            gathered = self._gather(kv, np.asarray([page], np.int32))
+        except Exception as e:  # noqa: BLE001 - the entry just drops
+            self.swap_failures += 1
+            log.warning("prefix demotion skipped: %s: %s",
+                        type(e).__name__, str(e)[:200])
+            return
+        t0 = _time.perf_counter()
+        with self._lock:
+            self._pending_ops += 1
+        fut = self._transfer.fetch(gathered)
+
+        def land(f):
+            try:
+                if self.store.put(("px", digest), np.asarray(f.result())):
+                    self.demotions += 1
+                    self.swap_out_bytes += self.page_nbytes
+                    if self.metrics is not None:
+                        self.metrics.observe_swap_out(
+                            _time.perf_counter() - t0, self.page_nbytes)
+            except Exception:  # noqa: BLE001
+                self.swap_failures += 1
+                log.exception("prefix demotion fetch failed")
+            finally:
+                with self._ops_cv:
+                    self._pending_ops -= 1
+                    self._ops_cv.notify_all()
+
+        fut.add_done_callback(land)
+
+    def has_prefix(self, digest: bytes) -> bool:
+        return ("px", digest) in self.store
+
+    def promote(self, digest: bytes, page: int, kv):
+        """Upload a demoted prefix page into ``page``.  Returns the new
+        donated pool buffer, or None (miss/failure — caller releases the
+        page and recomputes, today's path)."""
+        import jax
+
+        t0 = _time.perf_counter()
+        try:
+            if chaos.trip("kvcache.swap") == "drop":
+                raise chaos.ChaosError("injected swap drop")
+            arr = self.store.pop(("px", digest))
+            if arr is None:
+                return None
+            data = jax.device_put(arr, self.pool.device)
+        except Exception as e:  # noqa: BLE001 - pre-dispatch: degrade
+            self.swap_failures += 1
+            log.warning("prefix promotion degraded to recompute: %s: %s",
+                        type(e).__name__, str(e)[:200])
+            return None
+        new_kv = self._scatter(kv, np.asarray([page], np.int32), data)
+        self.promotions += 1
+        self.swap_in_bytes += self.page_nbytes
+        if self.metrics is not None:
+            self.metrics.observe_swap_in(_time.perf_counter() - t0,
+                                         self.page_nbytes)
+        return new_kv
+
+    # -- load signals ---------------------------------------------------------
+    def headroom_pages(self) -> int:
+        """How many more KV pages the host tier can absorb without
+        evicting (admission's host-tier headroom term)."""
+        return self.store.headroom_bytes // max(1, self.page_nbytes)
+
+    def demotable_pages(self, prefix_cache) -> int:
+        """Device pages that pressure could DEMOTE instead of drop right
+        now: capped both by what the cache holds and by host headroom."""
+        cached = len(prefix_cache) if prefix_cache is not None else 0
+        return min(cached, self.headroom_pages())
+
+    # -- lifecycle ------------------------------------------------------------
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until every write-behind copy (lane swap-outs AND prefix
+        demotions) has settled (tests, shutdown).  False on timeout."""
+        with self._ops_cv:
+            return self._ops_cv.wait_for(
+                lambda: self._pending_ops == 0, timeout)
+
+    def close(self) -> None:
+        self.drain(timeout=2.0)
+        if self._owns_transfer:
+            self._transfer.shutdown()
+        self.store.clear()
+
+
+def benchmark_kv_offload(lanes: int = 2, steps: int = 20,
+                         prompt_len: int = 12, page_size: int = 8,
+                         d_model: int = 64, n_heads: int = 4,
+                         n_layers: int = 2, vocab: int = 256,
+                         n_low: int = 4, n_hi: int = 4,
+                         dtype=None) -> Dict[str, Any]:
+    """The bench ``kv_offload`` row: goodput and re-prefill dispatches
+    under ~2x KV oversubscription, host tier on vs off.
+
+    The workload keeps ``n_low + n_hi`` requests outstanding against a
+    pool sized for ``lanes`` residents (outstanding KV demand ~2x the
+    pool): the low-priority half decodes long sequences, and each
+    high-priority preemptor is injected the moment a low lane is
+    observed decoding — every preemption then either re-prefills (tier
+    off) or swaps (tier on).  ``re_prefill_dispatches`` counts prefill
+    passes beyond the one each request legitimately pays; with the tier
+    on it should collapse toward zero.  On CPU jit the dispatch counts
+    are the signal (a re-prefill forward is cheap there); on-device each
+    avoided re-prefill is a whole prompt+generated forward not burned
+    twice, so goodput is the headline.
+    """
+    import threading as _th
+    import time
+
+    import jax.numpy as jnp
+
+    from tpulab.engine.paged import ContinuousBatcher
+    from tpulab.models.transformer import init_transformer_params
+
+    dtype = dtype or jnp.float32
+    low_steps = 2 * steps               # long victims: a real resume window
+    max_len = prompt_len + low_steps + 4
+    pages_per_req = (max_len + page_size - 1) // page_size
+    n_pages = lanes * pages_per_req + 1
+    outstanding = n_low + n_hi
+    params = init_transformer_params(vocab=vocab, d_model=d_model,
+                                     n_heads=n_heads, n_layers=n_layers,
+                                     d_ff=4 * d_model)
+    rng = np.random.default_rng(0)
+    low_prompts = [rng.integers(0, vocab, (prompt_len,), np.int32)
+                   for _ in range(n_low)]
+    hi_prompts = [rng.integers(0, vocab, (prompt_len,), np.int32)
+                  for _ in range(n_hi)]
+
+    def mode(offload_on: bool) -> Dict[str, Any]:
+        cb = ContinuousBatcher(
+            params, n_heads=n_heads, n_layers=n_layers, lanes=lanes,
+            max_len=max_len, page_size=page_size, n_pages=n_pages,
+            compute_dtype=dtype,
+            kv_offload=DEFAULT_HOST_BUDGET if offload_on else None)
+        try:
+            # warm the prefill/decode compiles out of the measurement
+            for f in [cb.submit(p, low_steps) for p in low_prompts[:lanes]]:
+                f.result(timeout=300)
+            for f in [cb.submit(p, steps) for p in hi_prompts[:lanes]]:
+                f.result(timeout=300)
+            pf0 = cb.prefill_dispatches
+            decoding = _th.Semaphore(0)  # one permit per low decode token
+            t0 = time.perf_counter()
+            futs = [cb.submit(p, low_steps,
+                              on_token=lambda _t, _i: decoding.release())
+                    for p in low_prompts]
+            for p in hi_prompts:
+                # inject each preemptor only once a low lane is decoding,
+                # so preemption (not plain admission) is what it exercises
+                decoding.acquire(timeout=30)
+                futs.append(cb.submit(p, steps, priority=10))
+            for f in futs:
+                f.result(timeout=300)
+            wall = max(1e-6, time.perf_counter() - t0)
+            entry = {
+                "goodput_rps": round(len(futs) / wall, 2),
+                "wall_s": round(wall, 3),
+                "preemptions": cb.preemptions,
+                "re_prefill_dispatches":
+                    cb.prefill_dispatches - pf0 - len(futs),
+            }
+            mgr = cb.kv_offload
+            if mgr is not None:
+                entry.update(
+                    swap_outs=mgr.swap_outs, swap_ins=mgr.swap_ins,
+                    swap_out_mb=round(mgr.swap_out_bytes / 2**20, 2),
+                    recompute_tokens_saved=mgr.recompute_tokens_saved,
+                    swap_failures=mgr.swap_failures)
+            return entry
+        finally:
+            cb.shutdown()
+
+    return {
+        "lanes": lanes, "steps": steps, "n_requests": n_low + n_hi,
+        "pool_pages": n_pages,
+        "oversubscription": round(
+            outstanding * pages_per_req / n_pages, 2),
+        "tier_off": mode(False),
+        "tier_on": mode(True),
+    }

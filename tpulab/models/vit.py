@@ -86,12 +86,16 @@ def _norm(x, np_, compute_dtype):
     family): one predicate keys the faithful-import path."""
     if "bias" in np_:
         eps = np_.get("eps", 1e-6)  # HF stores its config eps (1e-12)
-        # statistics in f32 (the strongly-typed eps promotes them — good:
-        # bf16 LN stats lose precision); the OUTPUT drops back to
-        # compute_dtype so the promotion never leaks into the matmuls
-        mu = jnp.mean(x, -1, keepdims=True)
-        var = jnp.mean(jnp.square(x - mu), -1, keepdims=True)
-        xn = (x - mu) * jax.lax.rsqrt(var + eps)
+        # statistics in f32 EXPLICITLY: under bf16 compute, mean/var of a
+        # bf16 x are themselves bf16 (a weakly-typed python eps does not
+        # promote the reduction inputs), and bf16 LN stats drift imported
+        # checkpoints' numerics away from HF's f32 LayerNorm.  The OUTPUT
+        # drops back to compute_dtype so the promotion never leaks into
+        # the matmuls.
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
+        xn = ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(compute_dtype)
         return (xn * np_["scale"].astype(compute_dtype)
                 + np_["bias"].astype(compute_dtype)).astype(compute_dtype)
     return _rmsnorm(x, np_["scale"].astype(compute_dtype))

@@ -264,8 +264,18 @@ class AdmissionController:
             if pool is not None:
                 page_size = int(getattr(eng, "page_size", 0)
                                 or getattr(pool, "page_size", 1))
-                if pool.free_pages * max(1, page_size) < cost:
-                    return False
+                free = int(pool.free_pages)
+                if free * max(1, page_size) < cost:
+                    # tiered KV (tpulab.kvcache): pages the engine can
+                    # DEMOTE to the host tier instead of dropping count as
+                    # headroom — admission sees the effective (HBM + host)
+                    # capacity, not just free HBM pages
+                    off = getattr(eng, "kv_offload", None)
+                    if off is not None:
+                        free += int(off.demotable_pages(
+                            getattr(eng, "prefix_cache", None)))
+                    if free * max(1, page_size) < cost:
+                        return False
             lanes = int(getattr(eng, "lanes", 0) or 0)
             if lanes and (int(getattr(eng, "active_lanes", 0)) >= lanes
                           and int(getattr(eng, "queued_requests", 0))

@@ -399,6 +399,115 @@ class GenerationMetrics:
             self._advance(self.prefix_misses, "misses", pc.misses)
 
 
+#: swap latency buckets (seconds): device<->host page copies — sub-ms on
+#: direct-attached hosts through tens of ms on relayed PjRt links
+SWAP_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                1., 2.5)
+
+
+class KVTierMetrics:
+    """Tiered-KV-cache telemetry (`_kv_tier_*`; tpulab.kvcache): swap
+    in/out bytes and latency distributions, demotion/promotion/drop
+    counters, recompute-tokens-saved, and host-tier occupancy gauges —
+    the view that says whether HBM pressure is being absorbed by the
+    host tier (demotions + promotions + tokens saved) or still destroying
+    state (drops + swap failures).  Latency/bytes are event-driven (pass
+    this object as the manager's ``metrics=``); counters/gauges advance
+    via :meth:`poll`."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.swap_out_bytes = Counter(
+            f"{ns}_kv_tier_swap_out_bytes",
+            "KV bytes copied device->host (lane swaps + demotions)",
+            registry=self.registry)
+        self.swap_in_bytes = Counter(
+            f"{ns}_kv_tier_swap_in_bytes",
+            "KV bytes copied host->device (restores + promotions)",
+            registry=self.registry)
+        self.swap_out_seconds = Histogram(
+            f"{ns}_kv_tier_swap_out_seconds",
+            "Swap-out latency (gather dispatch -> host-tier resident; "
+            "write-behind, so this is BEHIND the decode loop)",
+            buckets=SWAP_BUCKETS, registry=self.registry)
+        self.swap_in_seconds = Histogram(
+            f"{ns}_kv_tier_swap_in_seconds",
+            "Swap-in latency (restore entry -> scatter dispatched)",
+            buckets=SWAP_BUCKETS, registry=self.registry)
+        self.swap_outs = Counter(
+            f"{ns}_kv_tier_swap_outs", "Preempted-lane KV snapshots taken",
+            registry=self.registry)
+        self.swap_ins = Counter(
+            f"{ns}_kv_tier_swap_ins",
+            "Recompute-free resumes (snapshot restored, no re-prefill)",
+            registry=self.registry)
+        self.demotions = Counter(
+            f"{ns}_kv_tier_demotions",
+            "Prefix-cache pages demoted to the host tier",
+            registry=self.registry)
+        self.promotions = Counter(
+            f"{ns}_kv_tier_promotions",
+            "Prefix-cache pages promoted back from the host tier",
+            registry=self.registry)
+        self.swap_failures = Counter(
+            f"{ns}_kv_tier_swap_failures",
+            "Swaps degraded to the recompute path (chaos, transfer "
+            "errors, budget drops)", registry=self.registry)
+        self.host_drops = Counter(
+            f"{ns}_kv_tier_host_drops",
+            "Payloads refused by the host tier (larger than the budget)",
+            registry=self.registry)
+        self.host_evictions = Counter(
+            f"{ns}_kv_tier_host_evictions",
+            "Host-tier LRU entries pushed out by budget pressure",
+            registry=self.registry)
+        self.recompute_tokens_saved = Counter(
+            f"{ns}_kv_tier_recompute_tokens_saved",
+            "Prefill tokens resumes did NOT recompute (the tier's work "
+            "saved, in tokens)", registry=self.registry)
+        self.host_bytes = Gauge(
+            f"{ns}_kv_tier_host_bytes", "Host-tier payload bytes resident",
+            registry=self.registry)
+        self.host_entries = Gauge(
+            f"{ns}_kv_tier_host_entries", "Host-tier entries resident",
+            registry=self.registry)
+        self._last: Dict[str, int] = {}
+
+    # -- event hooks (called by KVOffloadManager) ----------------------------
+    def observe_swap_out(self, seconds: float, nbytes: int) -> None:
+        self.swap_out_seconds.observe(max(0.0, seconds))
+
+    def observe_swap_in(self, seconds: float, nbytes: int) -> None:
+        self.swap_in_seconds.observe(max(0.0, seconds))
+
+    def _advance(self, counter, key: str, value: int) -> None:
+        delta = value - self._last.get(key, 0)
+        if delta > 0:
+            counter.inc(delta)
+        self._last[key] = value
+
+    def poll(self, manager) -> None:
+        """Sample a KVOffloadManager (control-loop / poller hook)."""
+        self._advance(self.swap_out_bytes, "ob", manager.swap_out_bytes)
+        self._advance(self.swap_in_bytes, "ib", manager.swap_in_bytes)
+        self._advance(self.swap_outs, "so", manager.swap_outs)
+        self._advance(self.swap_ins, "si", manager.swap_ins)
+        self._advance(self.demotions, "dem", manager.demotions)
+        self._advance(self.promotions, "pro", manager.promotions)
+        self._advance(self.swap_failures, "fail", manager.swap_failures)
+        self._advance(self.recompute_tokens_saved, "saved",
+                      manager.recompute_tokens_saved)
+        store = manager.store
+        self._advance(self.host_drops, "drops", store.drops)
+        self._advance(self.host_evictions, "evict", store.evictions)
+        self.host_bytes.set(store.bytes_used)
+        self.host_entries.set(len(store))
+
+
 class AdmissionMetrics:
     """Admission-control telemetry (`_admission_*`; serving/admission.py):
     admitted/rejected/shed counters keyed by tenant (and rejection
@@ -494,7 +603,7 @@ class MultiRegistryCollector:
     through one registry (hence one /metrics port).  Metric names must be
     disjoint across the sub-registries — true by construction for the
     collectors in this module (``_request_*`` / ``_replica_*`` / ``_llm_*``
-    / ``_admission_*`` / ``_chaos_*`` prefixes)."""
+    / ``_admission_*`` / ``_kv_tier_*`` / ``_chaos_*`` prefixes)."""
 
     def __init__(self, registries: Sequence["CollectorRegistry"]):
         self._registries = list(registries)
