@@ -842,6 +842,23 @@ def main() -> None:
     except Exception as e:
         print(f"# kv offload row skipped: {e!r}", file=sys.stderr)
 
+    # disaggregated prefill/decode (docs/SERVING.md "Replica roles"):
+    # the same prefill-heavy trace served by one unified pool vs a
+    # prefill replica shipping finished KV over the host tier's wire
+    # form to a decode replica.  The claim tracked: the decode replica
+    # admits with ZERO prefill dispatches and its ITL tail stops paying
+    # for other requests' prompt forwards.  On CPU jit the dispatch
+    # counts + tail ratio are the signal; on-device the p99 gap is.
+    _phase("disagg")
+    try:
+        from tpulab.disagg import benchmark_disagg
+        _record(disagg=benchmark_disagg(
+            n_requests=4 if degraded else 8,
+            prompt_len=32 if degraded else 48,
+            steps=6 if degraded else 8))
+    except Exception as e:
+        print(f"# disagg row skipped: {e!r}", file=sys.stderr)
+
     # admission control under overload (docs/SERVING.md): offer ~2x the
     # measured capacity with per-request deadlines and record goodput
     # (deadline-met completions/s), shed rate, and p99 admission queue

@@ -83,6 +83,16 @@ def main():
     ap.add_argument("--tenant", default="",
                     help="client mode: tenant identity to send "
                          "(admission-control fairness/rate bucket)")
+    ap.add_argument("--role", default="unified",
+                    choices=("unified", "prefill", "decode"),
+                    help="disaggregated serving role (docs/SERVING.md "
+                         "'Replica roles'): prefill replicas export "
+                         "finished KV over the host tier's wire form, "
+                         "decode replicas admit shipped KV with zero "
+                         "prefill dispatches; implies kv_offload")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="client mode (multi-replica --connect): "
+                         "role-aware prefill/decode routing")
     ap.add_argument("--oneshot", action="store_true",
                     help="server exits after first client disconnect (tests)")
     args = ap.parse_args()
@@ -107,7 +117,8 @@ def main():
             # generation analog of examples/99's scale-out
             from tpulab.rpc.replica import GenerationReplicaSet
             addrs = [a.strip() for a in args.connect.split(",") if a.strip()]
-            grs = GenerationReplicaSet(addrs, args.model)
+            grs = GenerationReplicaSet(addrs, args.model,
+                                       disaggregate=args.disaggregate)
             try:
                 for tok in grs.generate(prompt, args.steps, **kw):
                     print(tok, end=" ", flush=True)
@@ -159,7 +170,10 @@ def main():
         params, n_heads=heads, n_layers=layers, n_kv_heads=kv_heads,
         lanes=args.lanes, max_len=args.max_len, rope_theta=rope_theta,
         prefix_cache=True, prefill_chunk=256,
-        kv_dtype=jnp.float8_e4m3fn if args.kv_fp8 else None)
+        kv_dtype=jnp.float8_e4m3fn if args.kv_fp8 else None,
+        # role'd replicas need the host tier: the KV handoff IS the
+        # tiered-KV swap path in wire form (tpulab.disagg)
+        kv_offload=args.role != "unified" or None)
 
     engines = {"llm": cb}
     if args.speculative > 0:
@@ -214,12 +228,12 @@ def main():
     # generation-only deployment: no dense models, just the Generate RPC
     mgr = tpulab.InferenceManager(max_exec_concurrency=1)
     mgr.serve(port=args.port, generation_engines=engines,
-              admission=admission)
+              admission=admission, role=args.role)
     print(f"LLM server on :{mgr.server.bound_port} "
           f"(lanes={args.lanes} max_len={args.max_len} "
           f"int8={args.int8} kv_fp8={args.kv_fp8} "
           f"kernel={cb.use_kernel} flash_prefill={cb.prefill_flash} "
-          f"admission={'on' if admission else 'off'})",
+          f"admission={'on' if admission else 'off'} role={args.role})",
           flush=True)
     import time
     try:

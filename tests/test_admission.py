@@ -633,7 +633,8 @@ def test_poll_load_reads_status_gauges():
         addr = f"127.0.0.1:{mgr.server.bound_port}"
         rs = ReplicaSet([addr], "lm")
         load = rs.poll_load()
-        assert load[addr] == {"queued_requests": 0, "free_kv_pages": 0}
+        assert load[addr] == {"queued_requests": 0, "free_kv_pages": 0,
+                              "role": "unified"}
         assert rs._load_hint == [0]
     finally:
         if rs is not None:

@@ -276,3 +276,69 @@ def test_admission_counts_host_headroom():
     assert not AdmissionController(load=_Eng(0))._capacity_ok_locked(64)
     assert AdmissionController(load=_Eng(7))._capacity_ok_locked(64)
     assert not AdmissionController(load=_Eng(3))._capacity_ok_locked(64)
+
+
+# -- HostKVStore edge cases ---------------------------------------------------
+
+def test_host_store_get_result_survives_eviction():
+    """A caller still holding a get() result must keep bit-exact data
+    after the entry's LRU eviction closes the backing mapping — the
+    copy-not-view contract under real eviction pressure."""
+    item = np.arange(1024, dtype=np.float32)          # 4 KiB
+    store = HostKVStore(2 * item.nbytes)
+    assert store.put("a", item)
+    held = store.get("a")                             # live result in hand
+    # pressure "a" out: two more puts exceed the budget and "a" is LRU'd
+    # ("a" was just touched by get, so fill past the WHOLE budget)
+    assert store.put("b", item + 1) and store.put("c", item + 2)
+    assert "a" not in store and store.evictions >= 1  # mapping is closed
+    np.testing.assert_array_equal(held, item)         # still bit-exact
+    store.clear()
+
+
+def test_host_store_oversize_put_does_not_evict_the_world():
+    """A payload larger than the ENTIRE budget must drop cleanly: refused
+    without evicting a single incumbent entry."""
+    item = np.zeros((1024,), np.float32)
+    store = HostKVStore(3 * item.nbytes)
+    for k in "abc":
+        assert store.put(k, item)
+    before = store.bytes_used
+    assert not store.put("huge", np.zeros((4096,), np.float32))
+    assert store.drops == 1 and store.evictions == 0
+    assert all(k in store for k in "abc")             # nobody was evicted
+    assert store.bytes_used == before
+    store.clear()
+
+
+def test_swap_drop_counted_separately_from_failures():
+    """A budget-refused snapshot is a swap_DROP (undersized host budget),
+    not a swap_failure (transfer/chaos) — and KVTierMetrics mirrors the
+    split."""
+    pool = PagedKVPool(6, 4, 2, 2, 8, jnp.float32)
+    # budget smaller than one page payload: the write-behind put refuses
+    mgr = KVOffloadManager(pool, host_budget_bytes=16)
+    try:
+        src = [pool.allocate_page()]
+        h = mgr.swap_out(src, length=4, kv=pool.kv)
+        assert h is not None
+        assert not h.wait(10)                 # landed nowhere
+        assert mgr.swap_drops == 1 and mgr.swap_failures == 0
+        pool.release_pages(src)
+        dst = [pool.allocate_page()]
+        # the restore then degrades (snapshot unavailable = failure path)
+        assert mgr.restore(h, dst, pool.kv) is None
+        assert mgr.swap_failures == 1
+        try:
+            import prometheus_client  # noqa: F401
+        except ImportError:
+            return
+        from tpulab.utils.metrics import KVTierMetrics
+        m = KVTierMetrics()
+        m.poll(mgr)
+        val = m.registry.get_sample_value
+        assert val("tpulab_kv_tier_swap_drops_total") == 1
+        assert val("tpulab_kv_tier_swap_failures_total") == 1
+    finally:
+        mgr.close()
+        pool.close()

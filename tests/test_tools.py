@@ -107,6 +107,21 @@ def test_gen_inference_pb2_schema_drift_and_roundtrip():
         queued_requests=4, free_kv_pages=99).SerializeToString())
     assert sr.queued_requests == 4 and sr.free_kv_pages == 99
 
+    # disaggregation fields (tpulab/disagg): replica role on Status,
+    # prefill_only/kv_shipment riding Generate both ways
+    sr2 = pb.StatusResponse.FromString(pb.StatusResponse(
+        role="prefill").SerializeToString())
+    assert sr2.role == "prefill"
+    assert pb.StatusResponse().role == ""   # pre-role replica: unified
+    dq = pb.GenerateRequest.FromString(pb.GenerateRequest(
+        prompt=[1, 2], steps=3, prefill_only=True,
+        kv_shipment=b"\x00wire\xff").SerializeToString())
+    assert dq.prefill_only and dq.kv_shipment == b"\x00wire\xff"
+    dr = pb.GenerateResponse.FromString(pb.GenerateResponse(
+        final=True, kv_shipment=b"snap").SerializeToString())
+    assert dr.final and dr.kv_shipment == b"snap"
+    assert pb.GenerateRequest().kv_shipment == b""  # absent = no shipment
+
 
 # -- capture policy (stubbed attempts; no device needed) ----------------------
 def _bc(monkeypatch, recs):

@@ -117,6 +117,11 @@ def build_file() -> dp.FileDescriptorProto:
         # and free KV-cache pages across continuous-batching engines
         field("queued_requests", 4, F.TYPE_INT64),
         field("free_kv_pages", 5, F.TYPE_INT64),
+        # disaggregated serving role: "prefill" | "decode" | "unified"
+        # (empty = pre-role replica, treated as unified).  Role-aware
+        # routers (GenerationReplicaSet disaggregate=True) read it via
+        # poll_load to learn which replicas prefill and which decode.
+        field("role", 6, F.TYPE_STRING),
     ])
 
     fd.message_type.add(name="HealthRequest")
@@ -148,6 +153,14 @@ def build_file() -> dp.FileDescriptorProto:
         field("trace_id", 13, F.TYPE_STRING),
         # admission-control tenant identity (see InferRequest.tenant_id)
         field("tenant_id", 14, F.TYPE_STRING),
+        # disaggregated prefill/decode (tpulab/disagg, docs/SERVING.md
+        # "Replica roles"): prefill_only runs the prompt prefill ONLY and
+        # returns the first token + the KV snapshot in wire form on the
+        # final response; kv_shipment carries that wire payload to a
+        # decode replica, which admits by PROMOTING the shipped KV
+        # through the host tier instead of prefilling
+        field("prefill_only", 15, F.TYPE_BOOL),
+        field("kv_shipment", 16, F.TYPE_BYTES),
     ])
     m.oneof_decl.add(name="_seed")
 
@@ -158,6 +171,11 @@ def build_file() -> dp.FileDescriptorProto:
         field("final", 3, F.TYPE_BOOL),
         field("status", 4, F.TYPE_MESSAGE, type_name="RequestStatus"),
         field("logprob", 5, F.TYPE_FLOAT),
+        # prefill_only responses: the finished prefill's KV snapshot in
+        # wire form (tpulab/disagg/wire.py), riding the final message;
+        # empty = export degraded (the router then lets the decode
+        # replica prefill locally)
+        field("kv_shipment", 6, F.TYPE_BYTES),
     ])
 
     e = fd.enum_type.add(name="StatusCode")
@@ -224,9 +242,18 @@ def main() -> int:
         "st = pb.RequestStatus.FromString(st.SerializeToString());"
         "assert st.code == pb.RESOURCE_EXHAUSTED == 6;"
         "assert st.retry_after_ms == 125;"
-        "sr = pb.StatusResponse(queued_requests=4, free_kv_pages=99);"
+        "sr = pb.StatusResponse(queued_requests=4, free_kv_pages=99,"
+        " role='prefill');"
         "sr = pb.StatusResponse.FromString(sr.SerializeToString());"
         "assert sr.queued_requests == 4 and sr.free_kv_pages == 99;"
+        "assert sr.role == 'prefill';"
+        "dq = pb.GenerateRequest(prompt=[1], steps=2, prefill_only=True,"
+        " kv_shipment=b'blob');"
+        "dq = pb.GenerateRequest.FromString(dq.SerializeToString());"
+        "assert dq.prefill_only and dq.kv_shipment == b'blob';"
+        "dr = pb.GenerateResponse(final=True, kv_shipment=b'wire');"
+        "dr = pb.GenerateResponse.FromString(dr.SerializeToString());"
+        "assert dr.kv_shipment == b'wire';"
         "r2 = pb.GenerateRequest();"
         "assert not r2.HasField('seed');"
         "r2.seed = 9; assert r2.HasField('seed');"

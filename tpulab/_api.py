@@ -36,7 +36,7 @@ class InferenceManager(_EngineManager):
               batch_window_s: float = 0.002,
               metrics=None, generation_engines=None,
               watchdog=None, trace=None,
-              admission=None) -> "InferenceManager":
+              admission=None, role: str = "unified") -> "InferenceManager":
         """Expose registered models over the TRTIS-style gRPC service
         (reference manager.serve() -> BasicInferService).  ``batching=True``
         enables server-side dynamic batching across concurrent callers;
@@ -46,7 +46,11 @@ class InferenceManager(_EngineManager):
         ``admission=AdmissionController(...)`` (tpulab.serving) arms the
         QoS frontend gate — overloaded requests fast-fail with
         RESOURCE_EXHAUSTED + retry_after_ms instead of queueing without
-        bound (docs/SERVING.md)."""
+        bound (docs/SERVING.md); ``role="prefill"|"decode"|"unified"``
+        declares the replica's disaggregated-serving role
+        (docs/SERVING.md "Replica roles") — reported over the Status RPC
+        so ``GenerationReplicaSet(disaggregate=True)`` routes prefills
+        and shipped-KV decodes to the right replicas."""
         if not self._allocated:
             # generation-only serving needs no dense models
             self.update_resources(allow_empty=bool(generation_engines))
@@ -54,7 +58,7 @@ class InferenceManager(_EngineManager):
             self, f"0.0.0.0:{port}", executor=executor, batching=batching,
             batch_window_s=batch_window_s, metrics=metrics, trace=trace,
             generation_engines=generation_engines, watchdog=watchdog,
-            admission=admission)
+            admission=admission, role=role)
         if wait:
             self._server.run()
         else:

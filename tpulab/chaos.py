@@ -57,6 +57,10 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
                               promote — error/drop degrade that swap to the
                               pre-offload recompute path (the lane/entry is
                               never corrupted, work is just recomputed)
+    disagg.ship               KVShipper export/import (tpulab.disagg) —
+                              error/drop lose that KV shipment: the decode
+                              replica degrades to a local prefill, never a
+                              corrupt lane or a stuck request
 """
 
 from __future__ import annotations

@@ -734,7 +734,7 @@ class _PagedRequest:
                  "sampling", "priority", "resumed", "admit_seq",
                  "stop_tokens", "want_logprobs", "logprobs_out", "deadline",
                  "trace_id", "t_submit", "t_prefill0", "t_first", "t_last",
-                 "chunk_t0", "chunk_start", "kv_handle")
+                 "chunk_t0", "chunk_start", "kv_handle", "export_digest")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
                  sampling: Optional[SamplingParams] = None,
@@ -757,6 +757,8 @@ class _PagedRequest:
         self.kv_handle = None    # host-tier KV snapshot of a preempted lane
         #                          (kvcache.SwapHandle); resume swaps it back
         #                          in instead of re-prefilling
+        self.export_digest = None  # disagg: demote finished KV to the host
+        #                            tier under ("ship", digest) at release
         self.admit_seq = -1      # admission order (preemption tie-break)
         self.stop_tokens = frozenset(int(t) for t in (stop_tokens or ()))
         self.want_logprobs = logprobs
@@ -1012,7 +1014,8 @@ class ContinuousBatcher:
                sampling: Optional[SamplingParams] = None,
                priority: int = 0, stop_tokens=None,
                logprobs: bool = False, deadline=None,
-               trace_id: Optional[str] = None) -> Future:
+               trace_id: Optional[str] = None,
+               export_digest: Optional[bytes] = None) -> Future:
         """``on_token(token, index)`` (optional) streams tokens as they
         decode — the hook the Generate RPC rides for paged serving.
         ``sampling`` selects the token policy (default greedy).
@@ -1034,7 +1037,14 @@ class ContinuousBatcher:
         one tick — and the future fails with DeadlineExceeded.
         ``trace_id`` tags this request's queue/prefill/decode spans in the
         attached ``trace`` recorder (the Generate RPC threads the client's
-        id through here, merging both processes into one timeline)."""
+        id through here, merging both processes into one timeline).
+        ``export_digest`` (requires ``kv_offload``) demotes the finished
+        request's KV to the host tier under ``("ship", digest)`` at lane
+        release — the prefill-replica half of disaggregated serving
+        (tpulab.disagg): submit with ``steps=1`` and the resulting
+        snapshot covers exactly the prompt; the export
+        :class:`~tpulab.kvcache.offload.SwapHandle` lands on the future
+        as ``_tpulab_kv_export`` (None when the swap degraded)."""
         flat = np.asarray(prompt).reshape(-1)
         if isinstance(deadline, Deadline):
             deadline = deadline.expiry
@@ -1051,10 +1061,93 @@ class ContinuousBatcher:
             # XLA gather CLAMPS out-of-bounds ids — silent garbage tokens;
             # reject at the host boundary instead
             raise ValueError(f"prompt token ids outside [0, {self.vocab})")
+        if export_digest is not None and self.kv_offload is None:
+            raise ValueError("export_digest requires kv_offload")
         req = _PagedRequest(prompt, steps, on_token=on_token,
                             sampling=sampling, priority=priority,
                             stop_tokens=stop_tokens, logprobs=logprobs,
                             deadline=deadline, trace_id=trace_id)
+        req.export_digest = export_digest
+        with self._cv:
+            if self._shutdown:
+                raise RuntimeError("ContinuousBatcher is shut down")
+            self._enqueue_locked(req, front_of_class=False)
+            self._requests[req.future] = req
+            self._cv.notify()
+        return req.future
+
+    def submit_shipped(self, prompt, steps: int, first_token: int,
+                       handle, on_token=None,
+                       sampling: Optional[SamplingParams] = None,
+                       priority: int = 0, stop_tokens=None, deadline=None,
+                       trace_id: Optional[str] = None) -> Future:
+        """Admit a request whose prompt KV arrived SHIPPED from a prefill
+        replica (tpulab.disagg) — the decode-replica half of
+        disaggregated serving.
+
+        ``handle`` is the resident host-tier snapshot a
+        :class:`~tpulab.disagg.KVShipper` import minted (None = shipment
+        lost: the request still admits and prefills locally), and
+        ``first_token`` the prefill replica's index-0 pick — emitted to
+        ``on_token`` here (index 0) so the stream the consumer sees is
+        identical to a unified replica's.  Admission promotes the
+        snapshot through the existing ``KVOffloadManager.restore`` path:
+        the lane starts decoding with ZERO prefill dispatches.  Every
+        degraded shipment (lost, corrupt, chaos-tripped, budget-refused,
+        restore failure) falls back to the exact local prefill — which
+        recomputes the same KV, so token parity holds either way.
+
+        Host-sampled requests (``temperature > 0`` without device
+        sampling) are rejected: their PRNG stream is keyed by draw
+        order, which does not survive the replica hop; greedy and
+        device-sampled streams are keyed by (seed, position) and do."""
+        flat = np.asarray(prompt).reshape(-1)
+        if isinstance(deadline, Deadline):
+            deadline = deadline.expiry
+        elif deadline is not None:
+            deadline = _time.monotonic() + float(deadline)
+        n_prompt = len(flat)
+        if n_prompt == 0:
+            raise ValueError("empty prompt")
+        if steps < 1:
+            raise ValueError("steps must be >= 1")
+        if n_prompt + steps > self.max_len:
+            raise ValueError(f"prompt+steps exceeds max_len {self.max_len}")
+        if flat.min() < 0 or flat.max() >= self.vocab:
+            raise ValueError(f"prompt token ids outside [0, {self.vocab})")
+        if not 0 <= int(first_token) < self.vocab:
+            raise ValueError(
+                f"shipped first token outside [0, {self.vocab})")
+        sp = sampling or SamplingParams()
+        if sp.temperature > 0.0 and not sp.device:
+            raise ValueError(
+                "shipped-KV admission requires greedy or device sampling "
+                "(host-side PRNG streams do not survive the replica hop)")
+        if handle is not None and self.kv_offload is None:
+            raise ValueError("shipped-KV admission requires kv_offload")
+        if handle is not None and handle.length != n_prompt:
+            raise ValueError(
+                f"shipment covers {handle.length} positions, prompt has "
+                f"{n_prompt}")
+        req = _PagedRequest(prompt, steps, on_token=on_token,
+                            sampling=sp, priority=priority,
+                            stop_tokens=stop_tokens, deadline=deadline,
+                            trace_id=trace_id)
+        # the first-token pick already happened on the prefill replica:
+        # seed the lane as a resume (a degraded restore then re-prefills
+        # and DISCARDS its logits, exactly like a preemption resume)
+        req.tokens_out.append(int(first_token))
+        req.kv_handle = handle
+        req.resumed = True
+        self.tokens_generated += 1
+        self._emit(req, int(first_token), 0, None)
+        if req.finished():  # steps == 1 or first token hit a stop token
+            if handle is not None and self.kv_offload is not None:
+                self.kv_offload.discard(handle)
+            req.kv_handle = None
+            req.future.set_result(self._result_of(req))
+            self.completed_requests += 1
+            return req.future
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("ContinuousBatcher is shut down")
@@ -1983,6 +2076,18 @@ class ContinuousBatcher:
         return toks
 
     def _release_lane_locked(self, lane: int, req: _PagedRequest) -> None:
+        if (req.export_digest is not None and self.kv_offload is not None
+                and not req.cancelled and req.length > 0
+                and req.finished()):
+            # disagg export: demote the finished KV to the host tier
+            # BEFORE the pages are released (dispatch order makes the
+            # gather safe — same window as preemption swap-out).  The
+            # handle rides the future; the shipper's export wait is the
+            # write-behind fence.
+            needed = (req.length + self.page_size - 1) // self.page_size
+            req.future._tpulab_kv_export = self.kv_offload.swap_out(
+                req.pages[:needed], req.length, self.pool.kv,
+                key=("ship", req.export_digest))
         self.pool.release_pages(req.pages)
         self._discard_handle(req)  # a cancelled resume never restores
         self._active[lane] = None

@@ -133,19 +133,24 @@ class KVOffloadManager:
         self.swap_ins = 0               # lane snapshots restored
         self.swap_out_bytes = 0
         self.swap_in_bytes = 0
-        self.swap_failures = 0          # chaos/transfer/budget degradations
+        self.swap_failures = 0          # chaos/transfer degradations
+        self.swap_drops = 0             # host-budget-refused snapshots
         self.demotions = 0              # prefix pages demoted to host
         self.promotions = 0             # prefix pages promoted back
         self.recompute_tokens_saved = 0  # prefill tokens resumes skipped
 
     # -- lane swap (preemption) ----------------------------------------------
-    def swap_out(self, pages: List[int], length: int, kv
-                 ) -> Optional[SwapHandle]:
+    def swap_out(self, pages: List[int], length: int, kv,
+                 key=None) -> Optional[SwapHandle]:
         """Snapshot ``pages`` (covering positions ``[0, length)``) to the
         host tier.  Dispatches the device gather and returns immediately;
         the D2H fetch + store happen behind the decode loop (write-
         behind).  None = degraded (chaos/failure): caller keeps today's
-        drop-and-re-prefill path."""
+        drop-and-re-prefill path.
+
+        ``key`` overrides the minted ``("lane", seq)`` store key — the
+        disaggregation path keys finished-prefill exports by prompt
+        digest (``("ship", digest)``) so the shipper can find them."""
         if not pages or length <= 0:
             return None
         try:
@@ -162,7 +167,8 @@ class KVOffloadManager:
             return None
         with self._lock:
             self._seq += 1
-            handle = SwapHandle(("lane", self._seq), n, length)
+            handle = SwapHandle(key if key is not None
+                                else ("lane", self._seq), n, length)
             self._pending_ops += 1
         t0 = _time.perf_counter()
         fut = self._transfer.fetch(gathered)
@@ -191,8 +197,16 @@ class KVOffloadManager:
                     self.metrics.observe_swap_out(
                         _time.perf_counter() - t0, arr.nbytes)
             else:
+                # budget-rejected put: NOT a transfer failure — a distinct
+                # counter (and log line) so an undersized host budget is
+                # diagnosable separately from a flaky transfer path
                 handle._state = _DROPPED
-                self.swap_failures += 1
+                self.swap_drops += 1
+                log.warning(
+                    "KV swap-out dropped: host tier refused %d bytes "
+                    "(budget %d, headroom %d) — host budget undersized?",
+                    arr.nbytes, self.store.budget_bytes,
+                    self.store.headroom_bytes)
         finally:
             handle._done.set()
             with self._ops_cv:
@@ -225,7 +239,14 @@ class KVOffloadManager:
             idx = np.zeros((_next_pow2(n),), np.int32)  # pad -> scratch 0
             idx[:n] = pages
             if n != idx.shape[0]:
-                pad = np.repeat(arr[:, -1:], idx.shape[0] - n, axis=1)
+                # padded slots all land on the reserved scratch page 0,
+                # so their payload is never read back: pad with ONE zero
+                # page broadcast across the pad width instead of
+                # np.repeat-ing the last real page (which allocated and
+                # shipped real-page copies for every non-pow2 snapshot)
+                zero = np.zeros_like(arr[:, :1])
+                pad = np.broadcast_to(
+                    zero, (arr.shape[0], idx.shape[0] - n) + arr.shape[2:])
                 arr = np.concatenate([arr, pad], axis=1)
             data = jax.device_put(arr, self.pool.device)
         except Exception as e:  # noqa: BLE001 - pre-dispatch: degrade
@@ -248,6 +269,41 @@ class KVOffloadManager:
         """Forget a snapshot that will never be restored (request
         cancelled/expired while queued)."""
         self.store.remove(handle.key)
+
+    # -- KV shipping (tpulab.disagg) -----------------------------------------
+    def take_snapshot(self, handle: SwapHandle,
+                      timeout: Optional[float] = None) -> Optional[np.ndarray]:
+        """One-shot fetch of a snapshot's host payload for wire export
+        (the disaggregation path).  Waits out the write-behind fence,
+        then POPS the entry — after a successful export the only copy is
+        the wire payload.  None when the snapshot was dropped/failed or
+        evicted (the caller degrades to shipping nothing: the decode
+        side prefills locally)."""
+        if not handle.wait(self.RESTORE_WAIT_S if timeout is None
+                           else timeout):
+            return None
+        return self.store.pop(handle.key)
+
+    def adopt(self, key, array: np.ndarray,
+              length: int) -> Optional[SwapHandle]:
+        """Land an externally produced snapshot (a shipped-KV import) in
+        the host tier and mint the already-RESIDENT handle that
+        :meth:`restore` consumes — the decode replica's admit-from-
+        shipped-KV entry point.  None when the budget refuses the
+        payload (counted in ``swap_drops``; the caller degrades to local
+        prefill)."""
+        array = np.ascontiguousarray(array)
+        n = int(array.shape[1])
+        if not self.store.put(key, array):
+            self.swap_drops += 1
+            log.warning("shipped KV snapshot refused by host tier "
+                        "(%d bytes, budget %d)", array.nbytes,
+                        self.store.budget_bytes)
+            return None
+        handle = SwapHandle(key, n, int(length))
+        handle._state = _RESIDENT
+        handle._done.set()
+        return handle
 
     # -- prefix-cache tiering ------------------------------------------------
     def demote(self, digest: bytes, page: int, kv) -> None:

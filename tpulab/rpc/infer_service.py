@@ -63,9 +63,16 @@ class InferResources(Resources):
     def __init__(self, manager, batching: bool = False,
                  batch_window_s: float = 0.002, metrics=None,
                  generation_engines: Optional[Dict[str, object]] = None,
-                 watchdog=None, trace=None, admission=None):
+                 watchdog=None, trace=None, admission=None,
+                 role: str = "unified"):
         self.manager = manager
         self.metrics = metrics
+        #: disaggregated serving role ("prefill" | "decode" | "unified",
+        #: docs/SERVING.md "Replica roles") — reported over the Status
+        #: RPC so role-aware routers can see it.  Advisory: the router
+        #: directs traffic by role; the service still serves whatever
+        #: arrives (degradation must never strand a request).
+        self.role = role
         #: optional tpulab.utils.tracing.ChromeTraceRecorder
         self.trace = trace
         #: optional tpulab.serving.AdmissionController — the QoS frontend
@@ -78,6 +85,7 @@ class InferResources(Resources):
         self._batch_window_s = batch_window_s
         self._batched: Dict[str, object] = {}
         self._generate_workers = None  # dedicated pool, built on first use
+        self._shippers: Dict[int, object] = {}  # engine id -> KVShipper
         self._lock = __import__("threading").Lock()
         # per-stage serving profile (sums + count): where a request's
         # milliseconds go between proto-in and proto-out — the measured
@@ -128,6 +136,21 @@ class InferResources(Resources):
                 self._generate_workers = ThreadPool(4, name="generate")
             return self._generate_workers
 
+    def shipper_for(self, engine):
+        """The engine's :class:`~tpulab.disagg.KVShipper` (lazy, one per
+        engine so ship counters accumulate), or None when the engine has
+        no host tier — the service then treats every shipment field as
+        absent and serves the plain path."""
+        mgr = getattr(engine, "kv_offload", None)
+        if mgr is None:
+            return None
+        with self._lock:
+            sh = self._shippers.get(id(engine))
+            if sh is None:
+                from tpulab.disagg import KVShipper
+                sh = self._shippers[id(engine)] = KVShipper(mgr)
+            return sh
+
     def runner(self, model_name: str):
         """Per-model runner; the batched variant aggregates concurrent
         requests into one device batch (examples/03 capability, in-process)."""
@@ -174,6 +197,7 @@ class StatusContext(Context):
                     pass
         resp.queued_requests = queued
         resp.free_kv_pages = free_pages
+        resp.role = res.role
         names = ([request.model_name] if request.model_name
                  else mgr.model_names)
         for name in names:
@@ -444,7 +468,8 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                         batch_window_s: float = 0.002,
                         metrics=None,
                         generation_engines: Optional[Dict[str, object]] = None,
-                        watchdog=None, trace=None, admission=None) -> Server:
+                        watchdog=None, trace=None, admission=None,
+                        role: str = "unified") -> Server:
     """Wire the inference service onto a Server
     (reference BasicInferService ctor infer.cc:644-678).
 
@@ -454,7 +479,10 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     :class:`tpulab.serving.AdmissionController`: the QoS frontend gate
     enforced on Infer / StreamInfer / Generate before any pooled resource
     is touched (docs/SERVING.md); rejected requests get
-    ``RESOURCE_EXHAUSTED`` + ``retry_after_ms``."""
+    ``RESOURCE_EXHAUSTED`` + ``retry_after_ms``.  ``role`` declares the
+    replica's disaggregated-serving role (``"prefill"`` / ``"decode"`` /
+    ``"unified"``, docs/SERVING.md "Replica roles"), reported over the
+    Status RPC for role-aware routers."""
     if admission is not None and trace is not None \
             and getattr(admission, "trace", None) is None:
         # adopt the service's recorder: admission-decision spans land on
@@ -464,7 +492,8 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                                batch_window_s=batch_window_s, metrics=metrics,
                                trace=trace,
                                generation_engines=generation_engines,
-                               watchdog=watchdog, admission=admission)
+                               watchdog=watchdog, admission=admission,
+                               role=role)
     server = Server(address, executor or Executor(n_threads=4))
     server._infer_resources = resources  # for shutdown
     service = AsyncService(SERVICE_NAME, resources)
@@ -591,10 +620,21 @@ class GenerateContext(StreamingContext):
         from tpulab.serving.admission import (AdmissionRejected,
                                               tenant_of_request)
         tc = TraceContext.of_request(request, self.grpc_context)
+        if request.kv_shipment:
+            # shipped-KV arrival (disaggregated decode): the prompt's KV
+            # arrives precomputed, so admission charges the PROMOTE cost
+            # (a page upload, ~prompt/16) plus the decode steps — not a
+            # full prefill's worth of tokens
+            cost = request.steps + max(1, len(request.prompt) // 16)
+        elif request.prefill_only:
+            # prefill-role request: prompt forward only, one token out
+            cost = len(request.prompt) + 1
+        else:
+            cost = len(request.prompt) + request.steps
         try:
             return True, res.admission.admit(
                 tenant=tenant_of_request(request, self.grpc_context),
-                cost=len(request.prompt) + request.steps,
+                cost=cost,
                 priority=request.priority, deadline=deadline,
                 trace_id=tc.trace_id if tc is not None else None)
         except AdmissionRejected as e:
@@ -607,6 +647,13 @@ class GenerateContext(StreamingContext):
     def _run_engine(self, engine, request: pb.GenerateRequest,
                     deadline) -> None:
         res = self.get_resources(InferResources)
+        if ((request.prefill_only or request.kv_shipment)
+                and not getattr(engine, "continuous_batching", False)):
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT,
+                message="disaggregated serving (prefill_only/kv_shipment) "
+                        "requires a continuous-batching engine")))
+            return
         if getattr(engine, "continuous_batching", False):  # explicit marker
             self._run_paged(engine, request, deadline)
             return
@@ -712,14 +759,88 @@ class GenerateContext(StreamingContext):
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
                 code=pb.INTERNAL, message=str(e))))
 
+    @staticmethod
+    def _sampling_of(request: pb.GenerateRequest):
+        """The request's SamplingParams (None = greedy) — shared by the
+        paged, prefill-export and shipped-admit paths so one request is
+        one sampling stream on every replica role."""
+        if request.temperature <= 0.0:
+            return None
+        from tpulab.engine.paged import SamplingParams
+        return SamplingParams(
+            temperature=request.temperature, top_k=request.top_k,
+            top_p=request.top_p,
+            seed=request.seed if request.HasField("seed") else None,
+            device=request.device_sampling)
+
+    def _run_prefill_export(self, engine, request: pb.GenerateRequest,
+                            deadline=None) -> None:
+        """Prefill-role serving (docs/SERVING.md "Replica roles"): run
+        the prompt prefill ONLY, demote the finished KV to the host tier
+        and ship it in wire form on the final response, with the first
+        token streamed as index 0.  A degraded export (swap dropped,
+        chaos-tripped) still returns the token — the router then lets
+        the decode replica prefill locally, so the request is never
+        stuck."""
+        res = self.get_resources(InferResources)
+        shipper = res.shipper_for(engine)
+        if shipper is None:
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT,
+                message="prefill_only requires kv_offload on the serving "
+                        "engine")))
+            return
+        from tpulab.disagg import prompt_digest
+        tc = TraceContext.of_request(request, self.grpc_context)
+        try:
+            kw = {}
+            if deadline is not None:
+                kw["deadline"] = deadline
+            if tc is not None:
+                kw["trace_id"] = tc.trace_id
+            digest = prompt_digest(request.prompt)
+            fut = engine.submit(np.asarray(request.prompt, np.int32), 1,
+                                sampling=self._sampling_of(request),
+                                priority=request.priority,
+                                export_digest=digest, **kw)
+            toks = fut.result(timeout=self.SESSION_LEASE_TIMEOUT_S)
+            first = int(toks[0])
+            blob = shipper.export(getattr(fut, "_tpulab_kv_export", None),
+                                  digest=digest, first_token=first)
+            self.write(pb.GenerateResponse(token=first, index=0))
+            final = pb.GenerateResponse(
+                final=True, status=pb.RequestStatus(code=pb.SUCCESS))
+            if blob:
+                final.kv_shipment = blob
+            self.write(final)
+        except DeadlineExceeded as e:
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.DEADLINE_EXCEEDED, message=str(e))))
+        except ValueError as e:  # submit()'s deterministic validation
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT, message=str(e))))
+        except Exception as e:  # noqa: BLE001
+            log.exception("prefill export failed")
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INTERNAL, message=str(e))))
+
     def _run_paged(self, engine, request: pb.GenerateRequest,
                    deadline=None) -> None:
         """Continuous-batching path: tokens stream from the batcher's
         on_token hook; many RPCs share the fused decode ticks.  Client
         disconnects cancel the batcher request (lane/pages free at the next
-        tick), and nothing is written after the final response."""
+        tick), and nothing is written after the final response.
+
+        Disaggregation (tpulab.disagg): ``prefill_only`` requests divert
+        to :meth:`_run_prefill_export`; a ``kv_shipment`` arrival is
+        imported and admitted through ``submit_shipped`` (zero prefill
+        dispatches) — any import/admit failure degrades to the plain
+        local-prefill submit below, which recomputes identical tokens."""
         import concurrent.futures as _f
         import time as _time
+        if request.prefill_only:
+            self._run_prefill_export(engine, request, deadline)
+            return
         finished = [False]
 
         def on_token(tok, i, logprob=None):
@@ -738,14 +859,7 @@ class GenerateContext(StreamingContext):
             engine.trace = res.trace
         tc = TraceContext.of_request(request, self.grpc_context)
         try:
-            sampling = None
-            if request.temperature > 0.0:
-                from tpulab.engine.paged import SamplingParams
-                sampling = SamplingParams(
-                    temperature=request.temperature, top_k=request.top_k,
-                    top_p=request.top_p,
-                    seed=request.seed if request.HasField("seed") else None,
-                    device=request.device_sampling)
+            sampling = self._sampling_of(request)
             kw = {}
             if deadline is not None:
                 # the batcher's tick sweep enforces it (lane/pages free
@@ -755,12 +869,36 @@ class GenerateContext(StreamingContext):
             if tc is not None:
                 # same gating: only traced requests carry the kwarg
                 kw["trace_id"] = tc.trace_id
-            fut = engine.submit(np.asarray(request.prompt, np.int32),
-                                request.steps, on_token=on_token,
-                                sampling=sampling,
-                                priority=request.priority,
-                                stop_tokens=list(request.stop_tokens),
-                                logprobs=request.return_logprobs, **kw)
+            if request.kv_shipment and not request.return_logprobs:
+                # shipped-KV admit: import into the local host tier and
+                # promote through the restore path — zero prefill
+                # dispatches.  ANY failure (corrupt wire, geometry
+                # mismatch, budget refusal, host-sampled lane) leaves
+                # fut None and the plain submit below prefills locally:
+                # same tokens, never a stuck request.
+                res2 = self.get_resources(InferResources)
+                shipper = res2.shipper_for(engine)
+                ship = (shipper.import_shipment(bytes(request.kv_shipment))
+                        if shipper is not None else None)
+                if ship is not None:
+                    try:
+                        fut = engine.submit_shipped(
+                            np.asarray(request.prompt, np.int32),
+                            request.steps, ship.first_token, ship.handle,
+                            on_token=on_token, sampling=sampling,
+                            priority=request.priority,
+                            stop_tokens=list(request.stop_tokens), **kw)
+                    except ValueError as e:
+                        shipper.discard(ship)
+                        log.warning("shipped-KV admit rejected, degrading "
+                                    "to local prefill: %s", e)
+            if fut is None:
+                fut = engine.submit(np.asarray(request.prompt, np.int32),
+                                    request.steps, on_token=on_token,
+                                    sampling=sampling,
+                                    priority=request.priority,
+                                    stop_tokens=list(request.stop_tokens),
+                                    logprobs=request.return_logprobs, **kw)
             lease_deadline = _time.monotonic() + self.SESSION_LEASE_TIMEOUT_S
             while True:
                 try:
@@ -855,7 +993,10 @@ class GenerateStreamClient:
                  return_logprobs: bool = False, top_p: float = 0.0,
                  deadline_s: Optional[float] = None,
                  trace_id: Optional[str] = None,
-                 tenant_id: Optional[str] = None):
+                 tenant_id: Optional[str] = None,
+                 kv_shipment: Optional[bytes] = None,
+                 prefill_only: bool = False,
+                 _final: Optional[list] = None):
         """Yields token ids; with ``return_logprobs=True`` yields
         ``(token, logprob)`` pairs instead.
 
@@ -871,7 +1012,15 @@ class GenerateStreamClient:
         (serving/admission.py) is the admission-control identity: it rides
         the request and the ``tpulab-tenant`` metadata; an overloaded
         server fast-fails with :class:`ResourceExhausted` carrying its
-        ``retry_after_ms`` backoff hint."""
+        ``retry_after_ms`` backoff hint.
+
+        Disaggregation (tpulab.disagg): ``kv_shipment`` hands the server
+        a prefill replica's wire-form KV snapshot to admit from
+        (degrades server-side to local prefill when unusable);
+        ``prefill_only=True`` asks for the prompt prefill + first token
+        only (use :meth:`prefill_export`, which also returns the
+        shipment).  ``_final`` (private) receives the final
+        GenerateResponse for callers that need its fields."""
         import queue as _q
         deadline = Deadline.after(deadline_s)
         out: "_q.Queue" = _q.Queue()
@@ -908,6 +1057,10 @@ class GenerateStreamClient:
             req.tenant_id = tenant_id
         if seed is not None:
             req.seed = seed
+        if kv_shipment:
+            req.kv_shipment = kv_shipment
+        if prefill_only:
+            req.prefill_only = True
         rem = deadline.remaining()
         if rem is not None:
             # RELATIVE budget, never wall clock: replica clocks differ
@@ -933,6 +1086,8 @@ class GenerateStreamClient:
                         "generation stream closed before completion"))
                 if resp.final:
                     finished = True
+                    if _final is not None:
+                        _final.append(resp)
                     if resp.status.code == pb.DEADLINE_EXCEEDED:
                         raise DeadlineExceeded(resp.status.message
                                                or "deadline exceeded")
@@ -950,6 +1105,23 @@ class GenerateStreamClient:
                 # consumer abandoned the generator mid-stream: cancel so
                 # the server stops decoding and frees the session slot
                 stream.cancel()
+
+    def prefill_export(self, prompt, timeout: float = 300.0,
+                       **kw) -> tuple:
+        """Run the prompt prefill on a PREFILL-role replica and return
+        ``(first_token, shipment_bytes)`` — the handoff half of
+        disaggregated serving (docs/SERVING.md "Replica roles").
+        ``shipment_bytes`` is None when the export degraded server-side;
+        the caller then routes the request to a decode replica WITHOUT a
+        shipment (local prefill there).  Keyword args are
+        :meth:`generate`'s (temperature/seed/deadline_s/trace_id/...)."""
+        final: list = []
+        toks = list(self.generate(prompt, 1, timeout=timeout,
+                                  prefill_only=True, _final=final, **kw))
+        blob = None
+        if final and final[0].kv_shipment:
+            blob = bytes(final[0].kv_shipment)
+        return (toks[0] if toks else None), blob
 
 
 # -- remote client ------------------------------------------------------------

@@ -123,6 +123,10 @@ class _BaseReplicaSet:
         #: last server-reported queued_requests per replica (Status RPC,
         #: refreshed by poll_load()) — the inflight tie-breaker
         self._load_hint = [0] * len(self._managers)
+        #: last server-reported disaggregation role per replica
+        #: ("prefill"/"decode"/"unified"/"" unknown; Status RPC via
+        #: poll_load()) — role-aware routing reads these
+        self._role_hint = [""] * len(self._managers)
         self._max_failover = (len(self._managers) if max_failover is None
                               else max_failover)
         # -- circuit breaker (0/None disables) ------------------------------
@@ -397,13 +401,22 @@ class _BaseReplicaSet:
         for i, addr, fut in futs:
             try:
                 resp = fut.result(timeout=timeout)
+                role = str(getattr(resp, "role", "") or "")
                 out[addr] = {"queued_requests": int(resp.queued_requests),
-                             "free_kv_pages": int(resp.free_kv_pages)}
+                             "free_kv_pages": int(resp.free_kv_pages),
+                             "role": role}
                 with self._lock:
                     self._load_hint[i] = int(resp.queued_requests)
+                    self._role_hint[i] = role
             except Exception as e:  # noqa: BLE001 - dead replica is data
                 out[addr] = {"error": f"{type(e).__name__}: {e}"}
         return out
+
+    def roles(self) -> Dict[str, str]:
+        """Last known disaggregation role per replica (poll_load
+        refreshes; "" = never heard)."""
+        with self._lock:
+            return dict(zip(self.addresses, self._role_hint))
 
     # -- dispatch -----------------------------------------------------------
     def _pick_locked(self, exclude: frozenset) -> Optional[int]:
@@ -633,12 +646,20 @@ class GenerationReplicaSet(_BaseReplicaSet):
     carries more than ``affinity_slack`` requests above the least-loaded
     one (or is excluded by failover), routing falls back to least-loaded
     — cache warmth must never become a hotspot or a single point of
-    failure."""
+    failure.
+
+    ``disaggregate=True`` adds role-aware prefill/decode routing
+    (tpulab.disagg, docs/SERVING.md "Replica roles"): greedy and
+    device-sampled requests prefill on a prefill-role replica, whose
+    finished KV ships over the host tier's wire form to a decode-role
+    replica picked by the same load gauges; every hole in the path
+    degrades to the unified routing with exactly-once delivery."""
 
     def __init__(self, addresses: Sequence[str], model_name: str,
                  channels: int = 1, max_failover: Optional[int] = None,
                  prefix_affinity: bool = False, affinity_tokens: int = 32,
-                 affinity_slack: int = 2, metrics=None, **breaker_kw):
+                 affinity_slack: int = 2, metrics=None,
+                 disaggregate: bool = False, **breaker_kw):
         super().__init__(addresses, model_name, channels, max_failover,
                          metrics=metrics, **breaker_kw)
         self._clients = [GenerateStreamClient(m, model_name)
@@ -646,6 +667,18 @@ class GenerationReplicaSet(_BaseReplicaSet):
         self.prefix_affinity = prefix_affinity
         self.affinity_tokens = affinity_tokens
         self.affinity_slack = affinity_slack
+        #: role-aware disaggregated routing (docs/SERVING.md "Replica
+        #: roles"): new requests go to a prefill-role replica first, the
+        #: finished prefill's KV shipment is handed to a decode-role
+        #: replica picked by the existing admission load gauges.  Any
+        #: hole in the path (no roles visible, host-sampled request,
+        #: logprobs, failure at either hop) falls back to the unified
+        #: routing below — exactly-once token delivery either way.
+        self.disaggregate = disaggregate
+        #: shipped handoffs that streamed from a decode replica (tests)
+        self.disagg_handoffs = 0
+        #: requests that degraded to unified routing (tests)
+        self.disagg_fallbacks = 0
 
     def _preferred(self, prompt) -> int:
         """Stable prefix-hash home for a prompt (same first
@@ -702,11 +735,19 @@ class GenerationReplicaSet(_BaseReplicaSet):
         if deadline_s is not None:
             kw["deadline_s"] = deadline_s
         prompt = list(np.asarray(prompt, np.int32))
+        if (self.disaggregate and not kw.get("return_logprobs")
+                and (not kw.get("temperature")
+                     or kw.get("device_sampling"))):
+            # greedy/device-sampled streams are (seed, position)-keyed and
+            # survive the replica hop; host-sampled + logprob requests
+            # stay on the unified path
+            return self._generate_disagg(prompt, steps, timeout, kw)
         return self._generate_iter(prompt, steps, timeout, kw)
 
-    def _generate_iter(self, prompt, steps, timeout, kw):
+    def _generate_iter(self, prompt, steps, timeout, kw,
+                       already_delivered: int = 0):
         deadline = Deadline.after(kw.pop("deadline_s", None))
-        delivered = 0
+        delivered = already_delivered
         attempts_left = self._max_failover
         exclude: set = set()
         # one trace id for the logical request: every replay attempt (and
@@ -797,3 +838,147 @@ class GenerationReplicaSet(_BaseReplicaSet):
                     self._note_inflight(idx)
                 if gen is not None:
                     gen.close()  # abandoned inner stream cancels promptly
+
+    # -- disaggregated routing (tpulab.disagg) -------------------------------
+    def _known_roles(self) -> List[str]:
+        """Per-replica role hints, polling the Status RPC once if none
+        have been heard yet (the common first-request case)."""
+        with self._lock:
+            roles = list(self._role_hint)
+        if not any(roles):
+            try:
+                self.poll_load()
+            except Exception:  # noqa: BLE001 - routing must not die here
+                pass
+            with self._lock:
+                roles = list(self._role_hint)
+        return roles
+
+    def _generate_disagg(self, prompt, steps, timeout, kw):
+        """Role-aware two-hop routing: prefill on a prefill-role replica
+        (first token + KV shipment back), decode on a decode-role
+        replica admitting the shipment — picked least-loaded within its
+        role by the same selection algorithm (inflight + the Status-RPC
+        load gauges).  Every hole degrades to the unified path with
+        exactly-once delivery: tokens already yielded are skipped on the
+        fallback replay, and a lost/unusable shipment simply means the
+        decode replica prefills locally (server-side degradation)."""
+        kw = dict(kw)
+        deadline = Deadline.after(kw.pop("deadline_s", None))
+        trace_id = kw.pop("trace_id", None) or mint_trace_id()
+        stops = {int(t) for t in kw.get("stop_tokens", ())}
+
+        def fallback(delivered):
+            fkw = dict(kw, trace_id=trace_id)
+            rem = deadline.remaining()
+            if rem is not None:
+                fkw["deadline_s"] = rem
+            self.disagg_fallbacks += 1
+            return self._generate_iter(list(prompt), steps, timeout, fkw,
+                                       already_delivered=delivered)
+
+        roles = self._known_roles()
+        prefills = {i for i, r in enumerate(roles) if r == "prefill"}
+        decodes = {i for i, r in enumerate(roles) if r == "decode"}
+        if not prefills or not decodes:
+            yield from fallback(0)
+            return
+        # -- hop 1: prefill + export ----------------------------------------
+        first = blob = None
+        idx = self._pick(frozenset(range(len(self._managers))) - prefills)
+        if idx is not None:
+            t_att = time.perf_counter()
+            try:
+                pkw = {k: kw[k] for k in ("temperature", "seed",
+                                          "device_sampling", "tenant_id",
+                                          "priority") if k in kw}
+                rem = deadline.remaining()
+                if rem is not None:
+                    pkw["deadline_s"] = rem
+                first, blob = self._clients[idx].prefill_export(
+                    prompt, timeout=deadline.bound(timeout),
+                    trace_id=trace_id, **pkw)
+                with self._lock:
+                    self.served[idx] += 1
+                self._record_success(idx)
+                self._note_served(idx)
+                self._note_attempt(None)
+                self._attempt_span(t_att, idx, 0, trace_id, None)
+            except Exception as e:  # noqa: BLE001 - any prefill-hop fault
+                #                      degrades to unified routing below
+                self._note_attempt(e)
+                self._attempt_span(t_att, idx, 0, trace_id, e)
+                if isinstance(e, DeadlineExceeded):
+                    self._note_deadline(False, deadline)
+                    raise  # finally below releases the inflight slot
+                from tpulab.rpc.infer_service import ResourceExhausted
+                if isinstance(e, ResourceExhausted):
+                    self._record_overload(idx, e.retry_after_ms)
+                else:
+                    self._record_failure(idx)
+                first, blob = None, None
+            finally:
+                with self._lock:
+                    self._inflight[idx] -= 1
+                    self._note_inflight(idx)
+        if first is None:
+            yield from fallback(0)
+            return
+        yield first
+        delivered = 1
+        if steps <= 1 or int(first) in stops:
+            self.disagg_handoffs += 1  # one-token request: prefill WAS it
+            return
+        # -- hop 2: shipped-KV decode ---------------------------------------
+        didx = self._pick(frozenset(range(len(self._managers))) - decodes)
+        if didx is None:
+            yield from fallback(delivered)
+            return
+        gen = None
+        t_att = time.perf_counter()
+        try:
+            dkw = dict(kw)
+            rem = deadline.remaining()
+            if rem is not None:
+                dkw["deadline_s"] = rem
+            gen = self._clients[didx].generate(
+                prompt, steps, timeout=deadline.bound(timeout),
+                trace_id=trace_id, kv_shipment=blob, **dkw)
+            i = 0
+            for item in gen:
+                if i >= delivered:  # index 0 was delivered from hop 1
+                    delivered += 1
+                    yield item
+                i += 1
+            with self._lock:
+                self.served[didx] += 1
+            self._record_success(didx)
+            self._note_served(didx)
+            self._note_attempt(None)
+            self._attempt_span(t_att, didx, 1, trace_id, None)
+            self._note_deadline(True, deadline)
+            self.disagg_handoffs += 1
+            return
+        except Exception as e:  # noqa: BLE001
+            self._note_attempt(e)
+            self._attempt_span(t_att, didx, 1, trace_id, e)
+            from tpulab.rpc.infer_service import (GenerationRejected,
+                                                  ResourceExhausted)
+            if isinstance(e, DeadlineExceeded):
+                self._note_deadline(False, deadline)
+                raise
+            if isinstance(e, GenerationRejected) and not e.retryable:
+                self._record_success(didx)  # deterministic rejection
+                raise
+            if isinstance(e, ResourceExhausted):
+                self._record_overload(didx, e.retry_after_ms)
+            else:
+                self._record_failure(didx)
+            # fall through to the unified replay below (skips delivered)
+        finally:
+            with self._lock:
+                self._inflight[didx] -= 1
+                self._note_inflight(didx)
+            if gen is not None:
+                gen.close()
+        yield from fallback(delivered)

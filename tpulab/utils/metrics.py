@@ -456,7 +456,12 @@ class KVTierMetrics:
         self.swap_failures = Counter(
             f"{ns}_kv_tier_swap_failures",
             "Swaps degraded to the recompute path (chaos, transfer "
-            "errors, budget drops)", registry=self.registry)
+            "errors)", registry=self.registry)
+        self.swap_drops = Counter(
+            f"{ns}_kv_tier_swap_drops",
+            "Snapshots the host tier's budget refused (distinct from "
+            "transfer failures: a sustained count means the host budget "
+            "is undersized)", registry=self.registry)
         self.host_drops = Counter(
             f"{ns}_kv_tier_host_drops",
             "Payloads refused by the host tier (larger than the budget)",
@@ -499,6 +504,7 @@ class KVTierMetrics:
         self._advance(self.demotions, "dem", manager.demotions)
         self._advance(self.promotions, "pro", manager.promotions)
         self._advance(self.swap_failures, "fail", manager.swap_failures)
+        self._advance(self.swap_drops, "sdrop", manager.swap_drops)
         self._advance(self.recompute_tokens_saved, "saved",
                       manager.recompute_tokens_saved)
         store = manager.store
