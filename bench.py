@@ -1070,15 +1070,23 @@ def main() -> None:
                     srv2.shutdown()
         _record(grpc_window_sweep=wsweep)
 
-    # speculative decoding's reason to exist, measured (VERDICT r4 #7):
-    # acceptance + speedup vs serving-shaped plain decode (emulated-draft
-    # caveat in the function doc).  LAST on purpose: a watchdog cut here
-    # costs only this row, never the serving rows above
-    if not degraded and not cpu_full and on_tpu:
+    # speculative decoding's reason to exist, measured ON THE SERVING
+    # PATH (ROADMAP item 4): acceptance rate, tok/s, and
+    # tokens-per-dispatch of speculative decode blocks vs plain K-blocks
+    # through the SAME ContinuousBatcher workload, greedy parity
+    # recorded in the row (the decode_dispatch discipline).  Supersedes
+    # the dense-path `speculative` row — benchmark_speculative_decode
+    # owns the plain baseline both modes share, so there is no
+    # duplicated baseline loop.  Runs on the CPU capture path too: the
+    # dispatch/sync/acceptance counts are the signal there; on-device
+    # the tok/s uplift is.  LAST on purpose: a watchdog cut here costs
+    # only this row, never the serving rows above
+    if not degraded:
         try:
-            _phase("speculative")
-            from tpulab.engine.speculative import benchmark_speculative
-            _record(speculative=benchmark_speculative())
+            _phase("speculative_decode")
+            from tpulab.engine.paged import benchmark_speculative_decode
+            _record(speculative_decode=benchmark_speculative_decode(
+                steps=32 if (cpu_full or not on_tpu) else 48))
         except Exception as e:
             print(f"# speculative row skipped: {e!r}", file=sys.stderr)
 

@@ -52,6 +52,12 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
                               overload), delay models a slow decision
     engine.step               ContinuousBatcher tick + GenerationSession.step
     engine.prefill            ContinuousBatcher fused prefill
+    engine.verify             ContinuousBatcher speculative verify dispatch
+                              (once per speculative dispatch, BEFORE it is
+                              issued) — error/drop degrade the dispatch's
+                              lanes to plain decode blocks for the rest of
+                              each request: nothing was emitted yet, so
+                              never a corrupt or duplicated token
     device.transfer           Bindings.copy_to_device (host->device staging)
     kvcache.swap              KVOffloadManager swap-out/restore/demote/
                               promote — error/drop degrade that swap to the

@@ -413,6 +413,189 @@ def _device_sample_token(row, temp, seed2, pos):
     return jnp.where(temp > 0, sampled, jnp.argmax(row)).astype(jnp.int32)
 
 
+def _paged_verify_forward(params, kv_pool, tables, lengths, seq, valid,
+                          n_heads: int, n_layers: int, compute_dtype,
+                          n_kv_heads: Optional[int] = None,
+                          rope_theta: Optional[float] = None):
+    """Batched multi-token target forward over the paged pool — the
+    verify half of :func:`paged_speculative_block`.
+
+    ``seq (B, M)`` int32: token m of lane b sits at global position
+    ``lengths[b] + m``.  All valid positions' K/V scatter into the
+    lane's pages first, then attention gathers the lane's whole block
+    table masked by global causality — the gather-after-scatter shape of
+    :func:`paged_extend`, batched over lanes.  ``valid (B, M)`` routes a
+    position's write to the reserved scratch page 0 when False (inactive
+    lane, or a position past the lane's step budget / page coverage) —
+    never to a live page; logits for invalid positions are garbage the
+    caller must not consume.  Returns ``(logits (B, M, vocab) f32,
+    kv_pool)`` — the fused pool donated by the caller.
+    """
+    import jax.numpy as jnp
+    from tpulab.models.transformer import (_dense_ffn, _lm_head, _rmsnorm,
+                                           apply_rope, qmat, split_qkv)
+
+    n_kv = n_kv_heads or n_heads
+    b, m = seq.shape
+    page_size = kv_pool.shape[3]
+    emb = params["embed"].astype(compute_dtype)
+    x = emb[seq]                                      # (B, M, D)
+    d_model = x.shape[-1]
+    head_dim = d_model // n_heads
+    pos = lengths[:, None] + jnp.arange(m)[None, :]   # (B, M)
+    # invalid positions' page index may run past the table width — XLA
+    # clamps the gather, and the mask below discards the clamped id
+    page_idx = jnp.where(valid,
+                         jnp.take_along_axis(
+                             tables,
+                             jnp.minimum(pos // page_size,
+                                         tables.shape[1] - 1), axis=1), 0)
+    slot_idx = jnp.where(valid, pos % page_size, 0)
+
+    for layer in range(n_layers):
+        p = params[f"layer{layer}"]
+        h = _rmsnorm(x, p["ln1"]["scale"])
+        qkv = h @ qmat(p["wqkv"], compute_dtype)
+        q, knew, vnew = split_qkv(qkv, b, m, n_heads, n_kv, head_dim)
+        if rope_theta:
+            q = apply_rope(q, pos, rope_theta)
+            knew = apply_rope(knew, pos, rope_theta)
+        kv_pool = kv_pool.at[layer, page_idx, 0, slot_idx].set(
+            knew.astype(kv_pool.dtype))
+        kv_pool = kv_pool.at[layer, page_idx, 1, slot_idx].set(
+            vnew.astype(kv_pool.dtype))
+        # gather-after-scatter: token m sees cached context + the chunk's
+        # own writes up to its position (mask is global causality)
+        attn = _gather_attend(q, kv_pool[layer, :, 0], kv_pool[layer, :, 1],
+                              tables, pos, compute_dtype)
+        x = x + attn @ qmat(p["wo"], compute_dtype)
+        h2 = _rmsnorm(x, p["ln2"]["scale"])
+        x = x + _dense_ffn(p, h2, compute_dtype).astype(x.dtype)
+
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    return _lm_head(params, x), kv_pool
+
+
+def paged_speculative_block(params, draft_params, kv_pool, tables,
+                            draft_tables, lengths, tokens, active, temps,
+                            seeds, steps_rem, stop_ids,
+                            n_heads: int, n_layers: int,
+                            draft_n_heads: int, draft_n_layers: int,
+                            compute_dtype, k: int = 4,
+                            n_kv_heads: Optional[int] = None,
+                            draft_n_kv_heads: Optional[int] = None,
+                            rope_theta: Optional[float] = None):
+    """Speculative decode: draft-propose + target-verify + per-lane
+    accept/reject, ALL inside one device dispatch.
+
+    A small draft model proposes ``k`` tokens per lane (a ``lax.scan``
+    of single-token draft steps through a SECOND page table on the same
+    fused pool), the target model verifies the current token plus all k
+    proposals in ONE batched forward (:func:`_paged_verify_forward`),
+    and acceptance runs on device: each lane emits the longest prefix of
+    proposals matching the target's own choices, plus the target's
+    correction (or bonus) token — so emitted tokens are EXACTLY the
+    non-speculative stream, and one dispatch emits up to ``k + 1``
+    tokens instead of ``k``.  The target's "choice" is
+    :func:`_device_sample_token` at each position — greedy argmax for
+    temp==0 lanes, and for device-sampled lanes the same
+    (seed, position)-folded stream plain blocks use, so token parity is
+    bit-exact in both modes.  The draft proposes through the SAME
+    sampling function on its own logits (a perfect draft then reaches
+    full acceptance under sampling too).
+
+    Stop-mask machinery matches :func:`paged_decode_block`: a stop token
+    is emitted as the lane's final token and truncates the emission; the
+    per-lane steps-remaining budget caps it, and writes past the budget
+    route to the scratch page (so a full-K block at the tail of a
+    request can never write past the positions its reservation covers).
+    Dead lanes emit nothing and write only scratch.  The draft scan runs
+    ``k + 1`` iterations (last proposal discarded) so a fully-accepted
+    round leaves no hole in the draft KV — the dense
+    :class:`~tpulab.engine.speculative.SpeculativeGenerator` trick.
+    Rejected proposals leave stale K/V past the accepted horizon in both
+    tables; positions only advance, so every stale slot is overwritten
+    before any later query may attend it.
+
+    The CALLER pre-allocates BOTH tables to cover positions
+    ``lengths .. lengths + k`` (see ``_reserve_spec_pages``).  Attention
+    uses the XLA gather fallback on both models (the pallas decode
+    kernel is single-query; a ragged multi-token verify kernel is the
+    next optimization).
+
+    Returns ``(tokens (B, k+1) i32, logprobs (B, k+1) f32, emitted
+    (B, k+1) bool prefix mask, lengths (B,), last_tokens (B,), live
+    (B,), steps_rem (B,), drafted (B,) i32, accepted (B,) i32,
+    kv_pool)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    seeds = seeds.astype(jnp.uint32)
+
+    # 1) draft proposes k tokens per lane through the second page table;
+    #    iterations past a lane's step budget write only scratch (their
+    #    proposals can never be emitted)
+    def dbody(carry, i):
+        kv, tok = carry
+        nt, _lp, _lg, kv = paged_decode_step(
+            draft_params, kv, draft_tables, lengths + i, tok,
+            active & (i < steps_rem),
+            n_heads=draft_n_heads, n_layers=draft_n_layers,
+            compute_dtype=compute_dtype, use_kernel=False,
+            n_kv_heads=draft_n_kv_heads, rope_theta=rope_theta,
+            temps=temps, seeds=seeds)
+        return (kv, nt), nt
+
+    (kv_pool, _), props = jax.lax.scan(dbody, (kv_pool, tokens),
+                                       jnp.arange(k + 1))
+    drafts = props[:k].T                               # (B, k)
+
+    # 2) target verifies [cur, d_0..d_{k-1}] in ONE batched forward;
+    #    position j's write is real only while the lane can still emit
+    #    token j (emitted n <= steps_rem, and query j consumes writes
+    #    0..j only, so masking j >= steps_rem discards nothing live)
+    seq = jnp.concatenate([tokens[:, None], drafts], axis=1)  # (B, k+1)
+    valid = active[:, None] & (jnp.arange(k + 1)[None, :]
+                               < steps_rem[:, None])
+    logits, kv_pool = _paged_verify_forward(
+        params, kv_pool, tables, lengths, seq, valid,
+        n_heads=n_heads, n_layers=n_layers, compute_dtype=compute_dtype,
+        n_kv_heads=n_kv_heads, rope_theta=rope_theta)
+
+    # 3) the target's own choice at every position — the same sampling
+    #    stream as plain blocks, so the output is bit-identical
+    pos = lengths[:, None] + jnp.arange(k + 1)[None, :]
+    cand = jax.vmap(jax.vmap(_device_sample_token,
+                             in_axes=(0, None, None, 0)))(
+        logits, temps, seeds, pos)                      # (B, k+1)
+    lsm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lps = jnp.take_along_axis(lsm, cand[..., None], axis=-1)[..., 0]
+
+    # 4) accept/reject + stop-mask, on device: emit the agreeing prefix
+    #    + correction, truncated by stop tokens and steps remaining
+    agree = drafts == cand[:, :k]
+    acc = jnp.cumprod(agree.astype(jnp.int32), axis=1).sum(axis=1)  # (B,)
+    avail = acc + 1                     # accepted prefix + correction
+    hit = (cand[:, :, None] == stop_ids[:, None, :]).any(axis=2)
+    first_stop = jnp.argmax(hit, axis=1)
+    stop_cap = jnp.where(hit.any(axis=1), first_stop + 1, k + 1)
+    n = jnp.minimum(jnp.minimum(avail, stop_cap), steps_rem)
+    n = jnp.where(active, n, 0)
+    emitted = jnp.arange(k + 1)[None, :] < n[:, None]   # (B, k+1)
+    lengths = lengths + n
+    last = jnp.take_along_axis(cand, jnp.maximum(n - 1, 0)[:, None],
+                               axis=1)[:, 0]
+    tokens = jnp.where(n > 0, last, tokens).astype(jnp.int32)
+    steps_rem = steps_rem - n
+    stopped = hit.any(axis=1) & (stop_cap <= n)
+    live = active & (steps_rem > 0) & ~stopped
+    drafted = jnp.where(active, k, 0)
+    accepted = jnp.where(active, jnp.minimum(acc, n), 0)
+    return (cand.astype(jnp.int32), lps, emitted, lengths, tokens, live,
+            steps_rem, drafted, accepted, kv_pool)
+
+
 def paged_prefill(params, kv_pool, tables, tokens, valid_len,
                   n_heads: int, n_layers: int, compute_dtype,
                   n_kv_heads: Optional[int] = None,
@@ -734,7 +917,9 @@ class _PagedRequest:
                  "sampling", "priority", "resumed", "admit_seq",
                  "stop_tokens", "want_logprobs", "logprobs_out", "deadline",
                  "trace_id", "t_submit", "t_prefill0", "t_first", "t_last",
-                 "chunk_t0", "chunk_start", "kv_handle", "export_digest")
+                 "chunk_t0", "chunk_start", "kv_handle", "export_digest",
+                 "draft_pages", "draft_len", "spec_enabled", "spec_ewma",
+                 "spec_drafted", "spec_accepted")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
                  sampling: Optional[SamplingParams] = None,
@@ -767,6 +952,15 @@ class _PagedRequest:
         #: per-iteration sweep cancels expired requests before their next
         #: step, freeing the lane and pages
         self.deadline = deadline
+        # -- speculative decode lane state (second page table) --------------
+        self.draft_pages: List[int] = []  # draft KV page ids (never shared)
+        self.draft_len = 0         # context positions the draft KV covers
+        self.spec_enabled = True   # False: plain blocks for the REST of
+        #                            the request (chaos verify trip, or the
+        #                            acceptance EWMA fell through the floor)
+        self.spec_ewma = 1.0       # rolling acceptance (optimistic start)
+        self.spec_drafted = 0      # draft proposals verified for this lane
+        self.spec_accepted = 0     # of those, emitted (accepted) ones
         # -- request-lifecycle telemetry (trace spans + latency metrics) ----
         self.trace_id = trace_id
         self.t_submit = _time.perf_counter()
@@ -809,6 +1003,16 @@ class ContinuousBatcher:
     cancellation/deadline sweeps act at block boundaries (a request stops
     within at most one block of the sweep observing it).
 
+    Speculative decoding (``draft_params=``, docs/PERFORMANCE.md): a
+    small draft model (e.g. :func:`tpulab.models.transformer.
+    early_exit_draft`) rides the SAME paged pool through a second
+    per-lane page table; each fused dispatch drafts K tokens, verifies
+    them in one batched target forward, and emits up to K+1 accepted
+    tokens — multiplying the block amortization by the acceptance rate
+    with bit-identical output.  Host-sampled lanes never speculate, and
+    lanes degrade to plain blocks on low acceptance, chaos verify trips,
+    or draft-table pool pressure.
+
     Tiered KV (``kv_offload=``, tpulab.kvcache): preemption swaps the
     victim's KV pages to a budgeted host-RAM tier (async, write-behind)
     and resume swaps them back with ZERO prefill dispatches; prefix-cache
@@ -847,7 +1051,12 @@ class ContinuousBatcher:
                  prefill_flash: Optional[bool] = None,
                  trace=None, metrics=None,
                  decode_block: int = 8,
-                 kv_offload=None):
+                 kv_offload=None,
+                 draft_params=None,
+                 draft_n_layers: Optional[int] = None,
+                 draft_n_heads: Optional[int] = None,
+                 draft_n_kv_heads: Optional[int] = None,
+                 spec_accept_floor: float = 0.35):
         import jax
         import jax.numpy as jnp
 
@@ -945,6 +1154,53 @@ class ContinuousBatcher:
                     compute_dtype=compute_dtype, n_kv_heads=n_kv,
                     rope_theta=rope_theta),
             donate_argnums=(1,))
+        # -- speculative decoding (a draft model riding the SAME pool
+        #    through a second per-lane page table; docs/PERFORMANCE.md) -----
+        # ``draft_params`` arms it: the draft proposes K tokens per lane
+        # inside the fused dispatch, the target verifies all of them in one
+        # batched forward, and each dispatch emits up to K+1 ACCEPTED
+        # tokens — multiplying the decode-block dispatch amortization by
+        # the acceptance rate.  Emitted tokens are bit-identical to the
+        # non-speculative stream (greedy and device-sampled); host-sampled
+        # lanes never enter the speculative path, and a lane whose rolling
+        # acceptance EWMA falls below ``spec_accept_floor`` (or whose
+        # verify dispatch trips chaos) degrades to plain blocks for the
+        # rest of its request.
+        self._spec: Optional[Dict[str, Any]] = None
+        self.spec_accept_floor = float(spec_accept_floor)
+        self.spec_dispatches = 0        # speculative decode dispatches
+        self.spec_fallbacks = 0         # lanes degraded to plain blocks
+        self.spec_draft_prefills = 0    # draft-table warm-up forwards
+        self.spec_tokens_drafted = 0    # proposals verified by the target
+        self.spec_tokens_accepted = 0   # of those, emitted (accepted)
+        self._spec_block_cache: Dict[int, Any] = {}
+        if draft_params is not None:
+            dl = draft_n_layers or n_layers
+            dh = draft_n_heads or n_heads
+            dkv = draft_n_kv_heads or (n_kv if draft_n_heads is None else dh)
+            dd = weight_shape(draft_params["layer0"]["wqkv"])[0]
+            if dd // dh != d_model // n_heads or dkv != n_kv:
+                raise ValueError(
+                    "draft model KV geometry (head_dim, n_kv_heads) must "
+                    "match the target's — both write the shared paged pool")
+            if dl > n_layers:
+                raise ValueError("draft_n_layers must be <= n_layers (the "
+                                 "draft shares the pool's layer axis)")
+            self._spec = {"params": jax.device_put(draft_params,
+                                                   self.pool.device),
+                          "n_heads": dh, "n_layers": dl, "n_kv_heads": dkv}
+            self._spec_kw = dict(n_heads=n_heads, n_layers=n_layers,
+                                 draft_n_heads=dh, draft_n_layers=dl,
+                                 compute_dtype=compute_dtype,
+                                 n_kv_heads=n_kv, draft_n_kv_heads=dkv,
+                                 rope_theta=rope_theta)
+            # draft-table warm-up: one fused draft forward over whatever
+            # context tail the second table is missing (never synced)
+            self._draft_extend = jax.jit(
+                partial(paged_extend, n_heads=dh, n_layers=dl,
+                        compute_dtype=compute_dtype, n_kv_heads=dkv,
+                        rope_theta=rope_theta),
+                donate_argnums=(1,))
         self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
         # host-memory KV tier (tpulab.kvcache): None/False = off (zero
         # cost); True = a manager with the default host budget; an int =
@@ -1192,6 +1448,21 @@ class ContinuousBatcher:
         with self._cv:
             return len(self._queue)
 
+    @property
+    def spec_acceptance(self) -> float:
+        """Lifetime draft acceptance rate (accepted / drafted)."""
+        return self.spec_tokens_accepted / max(1, self.spec_tokens_drafted)
+
+    @property
+    def admission_cost_factor(self) -> float:
+        """Cost multiplier the admission frontend applies to this
+        engine's requests (serving/admission.py).  A speculative request
+        holds a SECOND page table (the draft KV) next to the target's
+        and burns draft+verify compute on rejected proposals —
+        drafted-but-rejected tokens are not free, so cost-aware
+        admission must not plan capacity as if they were."""
+        return 2.0 if self._spec is not None else 1.0
+
     # -- telemetry (no-ops without an attached recorder/metrics) ------------
     def _span(self, name: str, lane: int, t0: float, dur: float,
               req: _PagedRequest, **extra) -> None:
@@ -1332,6 +1603,13 @@ class ContinuousBatcher:
                            pages=needed, tokens=req.length)
         self.pool.release_pages(req.pages)
         req.pages = []
+        # the draft table is never snapshotted: it is cheap to regenerate
+        # (one draft forward at resume), so its pages go home NOW and the
+        # resume's warm-up rebuilds it exactly
+        if req.draft_pages:
+            self.pool.release_pages(req.draft_pages)
+            req.draft_pages = []
+        req.draft_len = 0
         if req.tokens_out:
             # feed everything but the last emitted token; the resume
             # prefill's logits are discarded (that pick already happened)
@@ -1770,18 +2048,120 @@ class ContinuousBatcher:
                     new.pop()
         return k_eff, parts
 
+    def _spec_eligible(self, req: _PagedRequest) -> bool:
+        """May this lane ride a speculative dispatch?  Host-sampled
+        (``top_k``/``top_p``/host-PRNG temperature) lanes never enter the
+        speculative path — their picks need the logits row on host every
+        token; degraded lanes (chaos verify trip, acceptance EWMA under
+        the floor) stay plain for the rest of the request."""
+        sp = req.sampling
+        if sp.temperature > 0.0 and not sp.device:
+            return False
+        return req.spec_enabled
+
+    def _degrade_spec(self, req: _PagedRequest) -> None:
+        """Drop the lane to plain decode blocks for the REST of the
+        request; its draft-table pages go straight back to the pool."""
+        if req.spec_enabled:
+            req.spec_enabled = False
+            self.spec_fallbacks += 1
+        if req.draft_pages:
+            self.pool.release_pages(req.draft_pages)
+            req.draft_pages = []
+        req.draft_len = 0
+
+    def _reserve_spec_pages(self, decode_lanes, k: int):
+        """Target + draft page reservation for one speculative block.
+
+        A spec block writes ``k + 1`` positions (``lengths .. lengths+k``)
+        on BOTH tables and emits up to ``k + 1`` accepted tokens.  Target
+        pages are reserved FIRST (the plain fallback needs them
+        regardless); under pool pressure the DRAFT table's shortfall
+        shrinks the block k — it never steals or releases target pages.
+        Pages past the (possibly shrunk) write horizon go straight back
+        to the pool.  Returns ``(kd, parts)`` with ``parts`` entries
+        ``(lane, req, new_target_pages, new_draft_pages)``; ``kd == 0``
+        means the pool cannot support speculation this dispatch — the
+        caller falls back to the plain path (surviving target
+        reservations stay on the lanes for it, draft takes are
+        returned)."""
+        parts = []
+        cap = k + 1                   # min covered appends across lanes
+        for lane, req in decode_lanes:
+            rem = req.steps - len(req.tokens_out)
+            want = max(1, min(k + 1, rem))
+            need = (req.length + want - 1) // self.page_size + 1
+            new_t: List[int] = []
+            while len(req.pages) < need:
+                page = self._alloc_page()
+                if page is None:
+                    break
+                req.pages.append(page)
+                new_t.append(page)
+            cov_t = len(req.pages) * self.page_size - req.length
+            if cov_t <= 0:
+                for _ in new_t:   # starved: return the partial take
+                    self.pool.release_pages([req.pages.pop()])
+                continue
+            new_d: List[int] = []
+            while len(req.draft_pages) < need:
+                page = self._alloc_page()
+                if page is None:
+                    break
+                req.draft_pages.append(page)
+                new_d.append(page)
+            cov_d = len(req.draft_pages) * self.page_size - req.length
+            # only a COVERAGE shortfall shrinks the block: a lane whose
+            # step budget is smaller than the block is handled by the
+            # device-side steps-remaining mask (writes past the budget
+            # route to scratch), exactly like plain blocks
+            if cov_t < want:
+                cap = min(cap, cov_t)
+            if cov_d < want:
+                cap = min(cap, cov_d)
+            parts.append((lane, req, new_t, new_d))
+        if not parts or cap < 2:
+            # cannot cover even one proposal + its verify write: hand the
+            # draft takes back; target reservations stay for plain blocks
+            for _lane, req, _new_t, new_d in parts:
+                for _ in new_d:
+                    self.pool.release_pages([req.draft_pages.pop()])
+            return 0, []
+        kd = max(m for m in self.BLOCK_K_MENU if m <= cap - 1)
+        for _lane, req, new_t, new_d in parts:
+            rem = req.steps - len(req.tokens_out)
+            want = max(1, min(kd + 1, rem))
+            need = (req.length + want - 1) // self.page_size + 1
+            while len(req.pages) > need and new_t:
+                self.pool.release_pages([req.pages.pop()])
+                new_t.pop()
+            while len(req.draft_pages) > need and new_d:
+                self.pool.release_pages([req.draft_pages.pop()])
+                new_d.pop()
+        return kd, parts
+
     def _plan_decode(self, snapshot):
-        """Pick this dispatch's lanes, block size, and page reservations."""
+        """Pick this dispatch's lanes, mode (speculative vs plain), block
+        size, and page reservations.  The dispatch is speculative iff a
+        draft model is armed and EVERY participating lane is eligible
+        (one fused program serves the whole batch); otherwise — or when
+        pool pressure cannot cover the draft tables — it is a plain
+        block, which is the adaptive fallback the menu pick feeds."""
         decode_lanes = [(lane, req) for lane, req in enumerate(snapshot)
                         if req is not None and not req.cancelled
                         and not req.pending_prompt and req.tokens_out]
         if not decode_lanes:
             return None
         k = self._pick_block_k(decode_lanes)
+        if (self._spec is not None
+                and all(self._spec_eligible(r) for _, r in decode_lanes)):
+            kd, parts = self._reserve_spec_pages(decode_lanes, k)
+            if kd >= 1 and parts:
+                return {"k": kd, "parts": parts, "mode": "spec"}
         k, parts = self._reserve_block_pages(decode_lanes, k)
         if not parts:
             return None  # every lane page-starved: caller backs off
-        return {"k": k, "parts": parts}
+        return {"k": k, "parts": parts, "mode": "plain"}
 
     def _tick(self, snapshot, jnp) -> bool:
         """One scheduler decode pass: consume the dispatched-ahead block
@@ -1794,6 +2174,19 @@ class ContinuousBatcher:
         plan = self._plan_decode(snapshot)
         if plan is None:
             return False
+        if plan["mode"] == "spec":
+            stash = self._dispatch_spec_block(plan["parts"], plan["k"], jnp)
+            if stash is not None:
+                return self._consume_spec_block(stash, jnp)
+            # verify trip (chaos) pre-dispatch: the lanes just degraded to
+            # plain — re-plan this tick as a plain block (their target
+            # reservations are already in place)
+            lanes = [(lane, req) for lane, req, _nt, _nd in plan["parts"]]
+            k, parts = self._reserve_block_pages(
+                lanes, self._pick_block_k(lanes))
+            if not parts:
+                return False
+            plan = {"k": k, "parts": parts, "mode": "plain"}
         if plan["k"] == 1:
             return self._tick_single(plan["parts"], jnp)
         stash = self._dispatch_block(plan["parts"], plan["k"], jnp)
@@ -1946,6 +2339,189 @@ class ContinuousBatcher:
                 self._note_complete(req)
         return True
 
+    # -- speculative decode dispatch -----------------------------------------
+    SPEC_EWMA_DECAY = 0.5   # per-dispatch acceptance EWMA smoothing
+
+    def _spec_block_fn(self, k: int):
+        """Jitted speculative block (compiled once per draft length)."""
+        fn = self._spec_block_cache.get(k)
+        if fn is None:
+            import jax
+            fn = jax.jit(partial(paged_speculative_block, k=k,
+                                 **self._spec_kw),
+                         donate_argnums=(2,))
+            self._spec_block_cache[k] = fn
+        return fn
+
+    def _warm_draft(self, req: _PagedRequest, jnp) -> None:
+        """Bring the lane's draft KV up to the target context (positions
+        ``[draft_len, length)``): one fused draft forward over the
+        missing tail, scattered through the SECOND page table.  Costs a
+        dispatch but never a host sync (the logits are not fetched).
+        Runs at first speculative entry, after a preemption resume (the
+        draft table is released at preemption and regenerated exactly
+        here), and after plain-block interludes."""
+        t = req.length
+        if req.draft_len >= t:
+            return
+        ctx = np.concatenate([req.prompt,
+                              np.asarray(req.tokens_out[:-1], np.int32)])
+        start = req.draft_len
+        m = t - start
+        m_pad = 1 << (m - 1).bit_length()
+        tokens = np.zeros((1, m_pad), np.int32)
+        tokens[0, :m] = ctx[start:t]
+        tables = np.zeros((self.max_pages,), np.int32)
+        tables[:len(req.draft_pages)] = req.draft_pages
+        _last, self.pool.kv = self._draft_extend(
+            self._spec["params"], self.pool.kv, jnp.asarray(tables),
+            jnp.asarray(tokens), jnp.int32(start), jnp.int32(t))
+        req.draft_len = t
+        self.spec_draft_prefills += 1
+
+    def _dispatch_spec_block(self, parts, k: int, jnp):
+        """Issue one fused speculative dispatch (draft-propose + verify +
+        on-device accept).  Returns None when the verify trip point
+        fires (chaos): the participating lanes degrade to plain blocks
+        for the rest of their requests and NOTHING was dispatched — no
+        token is ever emitted twice, corrupted, or lost."""
+        # chaos: the speculative verify fault site — tripped once per
+        # speculative dispatch, BEFORE anything is issued, so error/drop
+        # degrade cleanly (the lanes' plain fallback re-decodes the very
+        # same positions).  Exercised like kvcache.swap: degradation, not
+        # request failure.
+        try:
+            tripped = chaos.trip("engine.verify")
+        except chaos.ChaosError:
+            tripped = "error"
+        if tripped is not None:
+            for _lane, req, _nt, _nd in parts:
+                self._degrade_spec(req)
+            return None
+        for _lane, req, _nt, _nd in parts:
+            self._warm_draft(req, jnp)
+        b = self.lanes
+        tables = np.zeros((b, self.max_pages), np.int32)
+        dtables = np.zeros((b, self.max_pages), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        tokens = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        temps = np.zeros((b,), np.float32)
+        seeds = np.zeros((b, 2), np.uint32)   # (lo, hi) words
+        rem = np.zeros((b,), np.int32)
+        n_stop = max((len(r.stop_tokens) for _, r, _nt, _nd in parts),
+                     default=0)
+        width = (1 << (n_stop - 1).bit_length()) if n_stop > 1 else 1
+        stops = np.full((b, width), -1, np.int32)  # ids >= 0: pad safe
+        lane_reqs = {}
+        for lane, req, _nt, _nd in parts:
+            lane_reqs[lane] = req
+            tables[lane, :len(req.pages)] = req.pages
+            dtables[lane, :len(req.draft_pages)] = req.draft_pages
+            lengths[lane] = req.length
+            tokens[lane] = req.tokens_out[-1]
+            active[lane] = True
+            rem[lane] = req.steps - len(req.tokens_out)
+            sp = req.sampling
+            if sp.device and sp.temperature > 0.0:
+                temps[lane] = sp.temperature
+                seeds[lane] = (sp.seed & 0xFFFFFFFF,
+                               (sp.seed >> 32) & 0xFFFFFFFF)
+            if req.stop_tokens:
+                st = sorted(req.stop_tokens)
+                stops[lane, :len(st)] = st
+        t0 = _time.perf_counter()
+        (toks, lps, ems, _len_f, _tok_f, _live_f, _rem_f, drafted,
+         accepted, self.pool.kv) = self._spec_block_fn(k)(
+            self.params, self._spec["params"], self.pool.kv,
+            jnp.asarray(tables), jnp.asarray(dtables),
+            jnp.asarray(lengths), jnp.asarray(tokens), jnp.asarray(active),
+            jnp.asarray(temps), jnp.asarray(seeds), jnp.asarray(rem),
+            jnp.asarray(stops))
+        self.decode_dispatches += 1
+        self.spec_dispatches += 1
+        return {"k": k, "lane_reqs": lane_reqs,
+                "dev": (toks, lps, ems, drafted, accepted), "t0": t0}
+
+    def _consume_spec_block(self, stash, jnp) -> bool:
+        """Fetch a speculative dispatch (ONE host sync for up to K+1
+        accepted tokens per lane), update each lane's acceptance EWMA,
+        and unpack through the per-token emit/trace/metrics path.
+        Drafted-but-rejected proposals are counted (``spec_tokens_*``)
+        but never emitted and never enter ``tokens_generated`` — so
+        tokens-per-dispatch telemetry reflects accepted tokens only."""
+        k = stash["k"]
+        toks = np.asarray(stash["dev"][0], np.int32)
+        lps = np.asarray(stash["dev"][1], np.float32)
+        ems = np.asarray(stash["dev"][2], bool)
+        drafted = np.asarray(stash["dev"][3], np.int32)
+        accepted = np.asarray(stash["dev"][4], np.int32)
+        self.decode_host_syncs += 1
+        now = _time.perf_counter()
+        self._step_ewma_s = (
+            0.8 * self._step_ewma_s + 0.2 * ((now - stash["t0"]) / (k + 1))
+            if self._step_ewma_s else (now - stash["t0"]) / (k + 1))
+        emits: List = []
+        completed: List = []
+        emitted_total = 0
+        accepted_total = 0
+        with self._cv:
+            for lane, req in stash["lane_reqs"].items():
+                if self._active[lane] is not req or req.cancelled:
+                    continue  # released since dispatch: block discarded
+                d, a = int(drafted[lane]), int(accepted[lane])
+                self.spec_tokens_drafted += d
+                self.spec_tokens_accepted += a
+                req.spec_drafted += d
+                req.spec_accepted += a
+                accepted_total += a
+                rate = a / d if d else 0.0
+                req.spec_ewma = (self.SPEC_EWMA_DECAY * req.spec_ewma
+                                 + (1.0 - self.SPEC_EWMA_DECAY) * rate)
+                if req.spec_ewma < self.spec_accept_floor:
+                    self._degrade_spec(req)
+                n = int(ems[lane].sum())   # prefix mask: first n are valid
+                if n == 0:
+                    continue
+                emitted_total += n
+                dt = (now - req.t_last) / n if req.t_last is not None \
+                    else None
+                for j in range(n):
+                    tok = int(toks[lane, j])
+                    req.length += 1
+                    req.tokens_out.append(tok)
+                    self.tokens_generated += 1
+                    if self.metrics is not None and dt is not None:
+                        self.metrics.observe_itl(dt)
+                    lp = float(lps[lane, j]) if req.want_logprobs else None
+                    if req.want_logprobs:
+                        req.logprobs_out.append(lp)
+                    emits.append((req, tok, len(req.tokens_out) - 1, lp))
+                req.t_last = now
+                if req.draft_pages:
+                    # the block's own draft writes cover every accepted
+                    # position (k+1 scan iterations: no holes)
+                    req.draft_len = req.length
+                self._flush_decode_chunk(req, lane, now, block=k,
+                                         accepted=a)
+                if req.finished():
+                    self._release_lane_locked(lane, req)
+                    completed.append(req)
+            self._admit_locked()
+        if self.trace is not None and emitted_total:
+            self.trace.add_counter("decode_block", now,
+                                   tokens=emitted_total, k=k,
+                                   accepted=accepted_total)
+        # user callbacks and future resolution OUTSIDE the scheduler lock
+        for req, tok, i, lp in emits:
+            self._emit(req, tok, i, lp)
+        for req in completed:
+            if not req.future.done():
+                req.future.set_result(self._result_of(req))
+                self.completed_requests += 1
+                self._note_complete(req)
+        return True
+
     def _tick_single(self, parts, jnp) -> bool:
         """K=1 decode tick (host-sampled lanes present, or decode_block=1):
         one dispatch + one fetch per token, the pre-block behavior."""
@@ -2089,6 +2665,9 @@ class ContinuousBatcher:
                 req.pages[:needed], req.length, self.pool.kv,
                 key=("ship", req.export_digest))
         self.pool.release_pages(req.pages)
+        if req.draft_pages:
+            self.pool.release_pages(req.draft_pages)
+            req.draft_pages = []
         self._discard_handle(req)  # a cancelled resume never restores
         self._active[lane] = None
         self._requests.pop(req.future, None)
@@ -2295,6 +2874,131 @@ def benchmark_decode_dispatch(ks=(1, 4, 8, 16), lanes: int = 4,
     if best is not None and k1.get("tok_s"):
         row["best_tok_s"] = best["tok_s"]
         row["uplift_vs_k1"] = round(best["tok_s"] / k1["tok_s"], 3)
+    return row
+
+
+def benchmark_speculative_decode(k: int = 8, lanes: int = 2,
+                                 steps: int = 48, prompt_len: int = 8,
+                                 d_model: int = 64, n_heads: int = 4,
+                                 n_layers: int = 4, draft_layers: int = 1,
+                                 vocab: int = 256,
+                                 tail_scale: float = 0.05,
+                                 dtype=None) -> Dict[str, Any]:
+    """tok/s, tokens-per-dispatch, host syncs, and acceptance rate of
+    speculative decode blocks vs plain K-blocks through the SAME
+    ContinuousBatcher workload (the bench ``speculative_decode`` row).
+
+    Supersedes the dense-path ``benchmark_speculative`` row for capture
+    purposes: both modes here share one serving-shaped workload function,
+    so there is no duplicated plain-baseline loop, and greedy parity is
+    recorded in the row like ``decode_dispatch`` does.  The draft is the
+    target's first ``draft_layers`` layers (early-exit) with the
+    post-exit output projections scaled by ``tail_scale`` — the
+    trained-model emulation :func:`benchmark_speculative` documents
+    (raw random tail layers pin acceptance to 0 and measure nothing).
+
+    On the CPU capture path the dispatch/sync/acceptance counts are the
+    signal (no link RTT to amortize); on-device the tok/s uplift is —
+    speculation multiplies the K-block amortization by the acceptance
+    rate, so off-chip served tok/s scales with ``(1 + acceptance*k)``
+    per round trip.
+    """
+    import time
+
+    import jax.numpy as jnp
+
+    from tpulab.models.transformer import (early_exit_draft,
+                                           init_transformer_params)
+
+    dtype = dtype or jnp.float32
+    params = init_transformer_params(vocab=vocab, d_model=d_model,
+                                     n_heads=n_heads, n_layers=n_layers,
+                                     d_ff=4 * d_model)
+    for i in range(draft_layers, n_layers):  # see tail_scale docstring
+        for w in ("wo", "w2"):
+            params[f"layer{i}"][w] = params[f"layer{i}"][w] * tail_scale
+    draft = early_exit_draft(params, draft_layers)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, vocab, (prompt_len,), np.int32)
+               for _ in range(lanes)]
+    max_len = prompt_len + steps + 8
+    row: Dict[str, Any] = {"lanes": lanes, "steps": steps, "k": k,
+                           "draft_layers": draft_layers}
+    outs: Dict[str, Any] = {}
+    for mode in ("plain", "spec"):
+        cb = ContinuousBatcher(
+            params, n_heads=n_heads, n_layers=n_layers, lanes=lanes,
+            max_len=max_len, page_size=8, compute_dtype=dtype,
+            decode_block=k,
+            n_pages=2 * lanes * ((max_len + 7) // 8) + 1,
+            draft_params=draft if mode == "spec" else None,
+            draft_n_layers=draft_layers)
+        try:
+            # warm the prefill/decode/draft compiles out of the measurement
+            for f in [cb.submit(p, steps) for p in prompts]:
+                f.result(timeout=600)
+            # deterministically pre-compile EVERY block size the adaptive
+            # scheduler may pick: which sizes a live warm run hits depends
+            # on admission interleaving and per-lane acceptance
+            # trajectories, and a compile landing in the measured window
+            # would swamp the tok/s signal.  A zero throwaway pool
+            # satisfies the donated argument without touching the live one.
+            base = (jnp.zeros((lanes, cb.max_pages), jnp.int32),
+                    jnp.zeros((lanes,), jnp.int32),
+                    jnp.zeros((lanes,), jnp.int32),
+                    jnp.zeros((lanes,), bool))
+            extra = (jnp.zeros((lanes,), jnp.float32),
+                     jnp.zeros((lanes, 2), jnp.uint32),
+                     jnp.zeros((lanes,), jnp.int32),
+                     jnp.full((lanes, 1), -1, jnp.int32))
+            for m in cb.BLOCK_K_MENU:
+                if m > k:
+                    continue
+                zkv = jnp.zeros(cb.pool.kv.shape, cb.pool.kv.dtype)
+                if mode == "spec":
+                    out = cb._spec_block_fn(m)(cb.params,
+                                               cb._spec["params"], zkv,
+                                               base[0], *base, *extra)
+                elif m > 1:   # k=1 plain runs _tick_single's step
+                    out = cb._block_fn(m)(cb.params, zkv, *base, *extra)
+                else:
+                    continue
+                np.asarray(out[0])    # fetch = compile fence
+            d0, s0 = cb.decode_dispatches, cb.decode_host_syncs
+            tg0 = cb.tokens_generated
+            dr0, ac0 = cb.spec_tokens_drafted, cb.spec_tokens_accepted
+            t0 = time.perf_counter()
+            futs = [cb.submit(p, steps) for p in prompts]
+            outs[mode] = [list(f.result(timeout=600)) for f in futs]
+            dt = time.perf_counter() - t0
+            toks = cb.tokens_generated - tg0
+            entry = {
+                "tok_s": round(toks / max(dt, 1e-9), 1),
+                "dispatches": cb.decode_dispatches - d0,
+                "host_syncs": cb.decode_host_syncs - s0,
+                # accepted (emitted) tokens only: drafted-but-rejected
+                # proposals never enter tokens_generated
+                "tokens_per_dispatch": round(
+                    toks / max(1, cb.decode_dispatches - d0), 2),
+                "syncs_per_token": round(
+                    (cb.decode_host_syncs - s0) / max(toks, 1), 4),
+            }
+            if mode == "spec":
+                drafted = cb.spec_tokens_drafted - dr0
+                accepted = cb.spec_tokens_accepted - ac0
+                entry["drafted"] = drafted
+                entry["accepted"] = accepted
+                entry["acceptance"] = round(accepted / max(1, drafted), 3)
+                entry["fallbacks"] = cb.spec_fallbacks
+            row[mode] = entry
+        except Exception as e:  # one mode's failure must not sink the row
+            row[mode] = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+        finally:
+            cb.shutdown()
+    if "tok_s" in row.get("plain", {}) and "tok_s" in row.get("spec", {}):
+        row["parity"] = outs["spec"] == outs["plain"]
+        row["uplift"] = round(row["spec"]["tok_s"]
+                              / max(row["plain"]["tok_s"], 1e-9), 3)
     return row
 
 

@@ -9,4 +9,13 @@ the binding boundary.
 
 from tpulab.models.registry import build_model, available_models
 
-__all__ = ["build_model", "available_models"]
+__all__ = ["build_model", "available_models", "early_exit_draft"]
+
+
+def __getattr__(name):
+    # lazy: tpulab.models.early_exit_draft (the draft-param plumbing for
+    # speculative decoding) without importing jax at package import time
+    if name == "early_exit_draft":
+        from tpulab.models.transformer import early_exit_draft
+        return early_exit_draft
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

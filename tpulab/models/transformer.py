@@ -392,6 +392,32 @@ def transformer_forward_collect_kv(params: Dict[str, Any],
                     rope_theta=rope_theta)
 
 
+def early_exit_draft(target_params: Dict[str, Any],
+                     draft_layers: int) -> Dict[str, Any]:
+    """Self-speculative draft: the target's first ``draft_layers`` layers
+    + its embed/final-norm/lm-head — 'early-exit' drafting (LayerSkip /
+    Draft-&-Verify family).  No second model to train or ship: the draft
+    IS a prefix of the target, so acceptance measures real early-exit
+    agreement rather than a synthetic twin.
+
+    The returned tree SHARES the target's weight arrays (no copy, no
+    extra HBM beyond what the target already holds) and, by
+    construction, the target's head geometry (head_dim, n_kv_heads) —
+    exactly what the paged speculative path requires, since the draft's
+    KV rides the target's :class:`~tpulab.engine.paged.PagedKVPool`
+    through a second page table (``ContinuousBatcher(draft_params=...,
+    draft_n_layers=...)``).  The dense
+    :class:`~tpulab.engine.speculative.SpeculativeGenerator` takes the
+    same tree."""
+    p = {"embed": target_params["embed"],
+         "final_norm": target_params["final_norm"]}
+    if "lm_head" in target_params:
+        p["lm_head"] = target_params["lm_head"]
+    for i in range(draft_layers):
+        p[f"layer{i}"] = target_params[f"layer{i}"]
+    return p
+
+
 def make_moe_transformer(vocab: int = 32000, d_model: int = 512,
                          n_heads: int = 8, n_layers: int = 6,
                          d_ff: int = 2048, n_experts: int = 8,

@@ -260,6 +260,13 @@ class AdmissionController:
         if eng is None:
             return True
         try:
+            # speculative decoding holds a draft page table next to the
+            # target's and burns draft+verify compute on rejected
+            # proposals — the engine reports how much bigger a request
+            # really is (ContinuousBatcher.admission_cost_factor);
+            # drafted-but-rejected tokens are not free
+            cost = int(cost * float(getattr(eng, "admission_cost_factor",
+                                            1.0) or 1.0))
             pool = getattr(eng, "pool", None)
             if pool is not None:
                 page_size = int(getattr(eng, "page_size", 0)

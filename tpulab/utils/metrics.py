@@ -329,6 +329,25 @@ class GenerationMetrics:
             "Blocking host syncs per generated token (1.0 = per-token "
             "round trips; ~1/(K*lanes) under fused decode)",
             registry=self.registry)
+        # -- speculative decode (draft/verify blocks; engine/paged.py) ------
+        self.spec_tokens_drafted = Counter(
+            f"{ns}_llm_spec_tokens_drafted",
+            "Draft-model proposals verified by the target (accepted or "
+            "rejected)", registry=self.registry)
+        self.spec_tokens_accepted = Counter(
+            f"{ns}_llm_spec_tokens_accepted",
+            "Draft proposals the target accepted (emitted as output "
+            "tokens)", registry=self.registry)
+        self.spec_fallbacks = Counter(
+            f"{ns}_llm_spec_fallbacks",
+            "Lanes degraded from speculative to plain decode blocks "
+            "(low acceptance, chaos verify trips)",
+            registry=self.registry)
+        self.spec_acceptance_rate = Gauge(
+            f"{ns}_llm_spec_acceptance_rate",
+            "Lifetime draft acceptance rate (accepted / drafted) — the "
+            "multiplier on the decode-block dispatch amortization",
+            registry=self.registry)
         self._ttft_res = _Reservoir()
         self._itl_res = _Reservoir()
         self._last: Dict[str, int] = {}
@@ -387,6 +406,18 @@ class GenerationMetrics:
         syncs = getattr(batcher, "decode_host_syncs", 0)
         self._advance(self.decode_dispatches, "dispatches", dispatches)
         self._advance(self.decode_host_syncs, "syncs", syncs)
+        # speculative decode telemetry: tokens_generated counts EMITTED
+        # (accepted) tokens only, so tokens_per_dispatch below is never
+        # inflated by drafted-but-rejected proposals — those show up
+        # exclusively in the drafted/accepted pair and the rate gauge
+        drafted = getattr(batcher, "spec_tokens_drafted", 0)
+        accepted = getattr(batcher, "spec_tokens_accepted", 0)
+        self._advance(self.spec_tokens_drafted, "spec_drafted", drafted)
+        self._advance(self.spec_tokens_accepted, "spec_accepted", accepted)
+        self._advance(self.spec_fallbacks, "spec_fallbacks",
+                      getattr(batcher, "spec_fallbacks", 0))
+        if drafted:
+            self.spec_acceptance_rate.set(accepted / drafted)
         if dispatches:
             self.tokens_per_dispatch.set(
                 batcher.tokens_generated / dispatches)
