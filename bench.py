@@ -1090,6 +1090,37 @@ def main() -> None:
         except Exception as e:
             print(f"# speculative row skipped: {e!r}", file=sys.stderr)
 
+    # sharded serving (docs/PERFORMANCE.md "Sharded serving"): the same
+    # ContinuousBatcher workload on a {"model": M} device mesh vs
+    # single-device.  Runs in a SUBPROCESS on fake CPU devices
+    # (--xla_force_host_platform_device_count=8): this process's backend
+    # is already bound, and the CPU-capture signal is token parity plus
+    # the preserved dispatch/host-sync counts (XLA's collectives ride
+    # inside the fused block program, so the one-sync-per-block contract
+    # survives sharding); on a real multi-chip slice the signal is tok/s
+    # with a model bigger than one chip's HBM.
+    if not degraded:
+        _phase("sharded_decode")
+        try:
+            prog = ("from tpulab.tpu.platform import force_cpu; "
+                    "force_cpu(8); import json; "
+                    "from tpulab.engine.paged import "
+                    "benchmark_sharded_decode; "
+                    "print(json.dumps(benchmark_sharded_decode()))")
+            env = dict(os.environ, PYTHONPATH=REPO,
+                       XLA_FLAGS="--xla_force_host_platform_device_count=8")
+            env.pop("JAX_PLATFORMS", None)  # force_cpu's config API rules
+            out = subprocess.run([sys.executable, "-c", prog],
+                                 capture_output=True, text=True,
+                                 timeout=600, env=env)
+            if out.returncode != 0:
+                raise RuntimeError(out.stderr[-400:])
+            _record(sharded_decode=dict(
+                json.loads(out.stdout.strip().splitlines()[-1]),
+                backend="cpu-fake-devices"))
+        except Exception as e:
+            print(f"# sharded decode row skipped: {e!r}", file=sys.stderr)
+
     _phase("emit")
     with _state_lock:
         _state["done"] = True

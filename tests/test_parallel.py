@@ -29,8 +29,17 @@ def test_make_mesh_shapes():
 
 
 def test_make_mesh_too_many_devices():
-    with pytest.raises(ValueError):
+    with pytest.raises(ValueError, match="needs 16 devices"):
         make_mesh({"data": 16})
+    with pytest.raises(ValueError, match="needs 12 devices"):
+        make_mesh({"data": 3, "model": 4})
+
+
+def test_default_mesh_rejects_nondivisible_model():
+    with pytest.raises(ValueError, match="not divisible"):
+        default_mesh(n_model=3)          # 8 devices % 3
+    with pytest.raises(ValueError, match="not divisible"):
+        default_mesh(n_model=2, devices=jax.devices()[:5])
 
 
 def test_transformer_param_shardings_rules():
@@ -42,6 +51,54 @@ def test_transformer_param_shardings_rules():
     assert sh["layer0"]["wo"].spec == P("model", None)
     assert sh["layer0"]["ln1"]["scale"].spec == P()
     assert sh["embed"].spec == P("model", None)
+
+
+def test_transformer_param_shardings_full_rule_tree():
+    """The complete Megatron-TP rule set over a multi-layer model:
+    wqkv/w1/w3/lm_head column-parallel, wo/w2 row-parallel, every norm
+    leaf replicated, tree structure preserved leaf-for-leaf, and every
+    leaf a NamedSharding on the given mesh."""
+    from jax.sharding import NamedSharding
+    params = init_transformer_params(vocab=64, d_model=16, n_heads=2,
+                                     n_layers=3, d_ff=32, ffn="swiglu",
+                                     tie_embeddings=False)
+    mesh = make_mesh({"model": 8})
+    sh = transformer_param_shardings(params, mesh)
+    # nesting preserved: identical treedef, all leaves NamedSharding
+    assert (jax.tree_util.tree_structure(params)
+            == jax.tree_util.tree_structure(sh))
+    for leaf in jax.tree_util.tree_leaves(
+            sh, is_leaf=lambda x: isinstance(x, NamedSharding)):
+        assert isinstance(leaf, NamedSharding) and leaf.mesh == mesh
+    for i in range(3):
+        layer = sh[f"layer{i}"]
+        for col in ("wqkv", "w1", "w3"):
+            assert layer[col].spec == P(None, "model"), (i, col)
+        for row in ("wo", "w2"):
+            assert layer[row].spec == P("model", None), (i, row)
+        for norm in ("ln1", "ln2"):
+            assert layer[norm]["scale"].spec == P(), (i, norm)
+    assert sh["lm_head"].spec == P(None, "model")   # vocab output dim
+    assert sh["embed"].spec == P("model", None)     # vocab input dim
+    assert sh["final_norm"]["scale"].spec == P()
+    # a custom axis name flows through every rule
+    sh2 = transformer_param_shardings(params, make_mesh({"tp": 4}),
+                                      model_axis="tp")
+    assert sh2["layer0"]["wqkv"].spec == P(None, "tp")
+    assert sh2["layer0"]["wo"].spec == P("tp", None)
+
+
+def test_kv_pool_sharding_spec():
+    """Page payloads shard on the KV-heads dim (axis 4 of the fused
+    (L, P, 2, S, Hkv, D) layout); page tables are host arrays and never
+    see this spec."""
+    from tpulab.parallel import kv_pool_sharding
+    mesh = make_mesh({"model": 2})
+    assert kv_pool_sharding(mesh).spec == P(None, None, None, None,
+                                            "model", None)
+    mesh2 = make_mesh({"tp": 2})
+    assert kv_pool_sharding(mesh2, model_axis="tp").spec == \
+        P(None, None, None, None, "tp", None)
 
 
 # -------------------------------------------------------------- attention ---

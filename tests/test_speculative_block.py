@@ -474,3 +474,93 @@ def test_benchmark_speculative_decode_row(lm):
     assert row["spec"]["tok_s"] > 0 and row["plain"]["tok_s"] > 0
     assert row["spec"]["tokens_per_dispatch"] > 0
     assert row["spec"]["drafted"] >= row["spec"]["accepted"] > 0
+
+
+# -- transient-degrade probes (re-enable speculation within a request) -----
+def test_spec_probe_policy_state_machine(lm):
+    """The probe state machine in isolation: a probe=True degrade arms a
+    countdown, SPEC_PROBE_INTERVAL plain consumes later the lane
+    re-enters speculation AS A PROBE with its EWMA reset to the floor;
+    a probe=False (chaos) degrade never arms one."""
+    cb = _batcher(lm, draft="self", lanes=1)
+    try:
+        req = _PagedRequest(np.ones(4, np.int32), 40)
+        req.tokens_out = [1]
+        cb._degrade_spec(req, probe=True)
+        assert not req.spec_enabled and req.spec_probe_in == \
+            cb.SPEC_PROBE_INTERVAL
+        for i in range(cb.SPEC_PROBE_INTERVAL - 1):
+            cb._probe_countdown_locked(req)
+            assert not req.spec_enabled, i
+        cb._probe_countdown_locked(req)
+        assert req.spec_enabled and req.spec_probing
+        assert req.spec_ewma == cb.spec_accept_floor
+        assert req.spec_probe_in is None
+        assert cb.spec_probes == 1
+
+        # chaos degrade: permanent — the countdown never arms
+        req2 = _PagedRequest(np.ones(4, np.int32), 40)
+        req2.tokens_out = [1]
+        cb._degrade_spec(req2)          # probe=False
+        assert req2.spec_probe_in is None
+        for _ in range(3 * cb.SPEC_PROBE_INTERVAL):
+            cb._probe_countdown_locked(req2)
+        assert not req2.spec_enabled and not req2.spec_probing
+    finally:
+        cb.shutdown()
+
+
+def test_spec_probe_recovers_after_transient_degrade(lm, dense):
+    """A lane degraded by a TRANSIENT acceptance dip recovers: with a
+    perfect (self) draft, a forced EWMA-style degrade runs plain blocks
+    for SPEC_PROBE_INTERVAL dispatches, then one probe block whose
+    perfect acceptance re-enables speculation for the rest of the
+    request — and the emitted stream stays exactly greedy throughout."""
+    import time as _t
+    p = np.random.default_rng(17).integers(0, 64, (5,), np.int32)
+    cb = _batcher(lm, draft="self", lanes=1, max_len=96)
+    try:
+        started = threading.Event()
+        fut = cb.submit(p, 60, on_token=lambda t, i: started.set())
+        assert started.wait(timeout=120)
+        # transient degrade, exactly what a low-acceptance stretch does
+        with cb._cv:
+            req = next(r for r in cb._active if r is not None)
+            cb._degrade_spec(req, probe=True)
+        spec_after_degrade = cb.spec_dispatches
+        got = list(fut.result(timeout=300))
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(dense(p[None, :], 60)[0]))
+        assert cb.spec_probes >= 1
+        assert cb.spec_probe_recoveries >= 1
+        # recovery is real: speculative dispatches resumed after the probe
+        assert cb.spec_dispatches > spec_after_degrade
+        deadline = _t.monotonic() + 10
+        while (_t.monotonic() < deadline
+               and cb.pool.free_pages != cb.pool.n_pages - 1):
+            _t.sleep(0.01)
+    finally:
+        cb.shutdown()
+    assert cb.pool.free_pages == cb.pool.n_pages - 1
+
+
+def test_spec_probe_stays_degraded_on_adversarial_draft(lm, dense):
+    """Probes on a lane whose draft is truly bad keep failing closed: the
+    argmin draft degrades the lane via the EWMA, periodic probes fire
+    (spec_probes advances) but never recover (zero recoveries), output
+    stays exactly greedy, and between probes the lane runs plain."""
+    bad = dict(early_exit_draft(lm, 2))
+    bad["lm_head"] = -np.asarray(lm["embed"]).T
+    p = np.random.default_rng(4).integers(0, 64, (5,), np.int32)
+    cb = _batcher(lm, draft=bad, draft_n_layers=2, lanes=1, max_len=128)
+    try:
+        got = list(cb.submit(p, 80).result(timeout=300))
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(dense(p[None, :], 80)[0]))
+        assert cb.spec_fallbacks >= 2      # initial degrade + failed probe
+        assert cb.spec_probes >= 1
+        assert cb.spec_probe_recoveries == 0
+        assert cb.decode_dispatches > cb.spec_dispatches
+    finally:
+        cb.shutdown()
+    assert cb.pool.free_pages == cb.pool.n_pages - 1

@@ -24,6 +24,13 @@ dropped (budget), failed (transfer error) or chaos-tripped
 re-prefill/recompute path — offload can only *save* work, never corrupt
 a lane.
 
+Sharded pools (mesh serving): the snapshot gather of a sharded pool
+produces a payload sharded like the pool (KV-heads dim); the
+TransferEngine fetch assembles it into ONE unsharded host array, so the
+host tier and the disagg wire always hold mesh-portable bytes, and
+restore's device_put re-shards them onto the LOCAL pool placement — a
+decode replica on a different mesh imports bit-exactly.
+
 Ordering safety: the gather that snapshots pages is dispatched BEFORE
 the pages are released, and XLA executes a device's programs in
 dispatch order — any later write into a recycled page is ordered after
@@ -103,7 +110,10 @@ class KVOffloadManager:
         import jax.numpy as jnp
 
         self.pool = pool
-        self.store = store or HostKVStore(host_budget_bytes)
+        # identity check, not truthiness: an EMPTY HostKVStore is falsy
+        # (__len__ == 0) and `store or ...` would silently replace it
+        self.store = store if store is not None \
+            else HostKVStore(host_budget_bytes)
         if transfer is None:
             from tpulab.tpu.transfer import TransferEngine
             transfer = TransferEngine(name="kvswap")
@@ -120,10 +130,17 @@ class KVOffloadManager:
         # page-index gathers/scatters, padded to pow2 page counts so the
         # jit cache stays at log2 variants (padding rides the RESERVED
         # scratch page 0: reads of it are discarded, writes to it are
-        # harmless by the pool's own contract)
-        self._gather = jax.jit(lambda kv, idx: kv[:, idx])
-        self._scatter = jax.jit(lambda kv, idx, data: kv.at[:, idx].set(data),
-                                donate_argnums=(0,))
+        # harmless by the pool's own contract).  Cached per (pow2 count,
+        # POOL PLACEMENT): the placement — mesh axes + spec + device set,
+        # or the single bound device — must be part of the key, so a pool
+        # re-pointed at a different mesh (a decode replica importing onto
+        # its own topology) can never reuse a scatter compiled for the
+        # old placement.  Sharded pools round-trip bit-exactly: the
+        # gather's payload is fetched to ONE unsharded host array (the
+        # host tier and the disagg wire always hold mesh-portable bytes)
+        # and restore re-shards it onto the local placement at device_put.
+        self._gather_fns: Dict[Any, Any] = {}
+        self._scatter_fns: Dict[Any, Any] = {}
         self._lock = threading.Lock()
         self._ops_cv = threading.Condition(self._lock)
         self._seq = 0
@@ -138,6 +155,42 @@ class KVOffloadManager:
         self.demotions = 0              # prefix pages demoted to host
         self.promotions = 0             # prefix pages promoted back
         self.recompute_tokens_saved = 0  # prefill tokens resumes skipped
+
+    # -- placement-keyed jits ---------------------------------------------
+    def _placement_key(self):
+        """Fingerprint of where pool-shaped arrays live: mesh axes + spec
+        + device ids for a sharded pool, the bound device otherwise."""
+        sh = getattr(self.pool, "kv_sharding", None)
+        if sh is None:
+            d = self.pool.device
+            return ("dev", getattr(d, "id", id(d)))
+        return ("mesh", tuple(sh.mesh.shape.items()), str(sh.spec),
+                tuple(int(d.id) for d in sh.mesh.devices.flat))
+
+    def _gather_fn(self, n_padded: int):
+        import jax
+        key = (n_padded, self._placement_key())
+        fn = self._gather_fns.get(key)
+        if fn is None:
+            fn = jax.jit(lambda kv, idx: kv[:, idx])
+            self._gather_fns[key] = fn
+        return fn
+
+    def _scatter_fn(self, n_padded: int):
+        import jax
+        key = (n_padded, self._placement_key())
+        fn = self._scatter_fns.get(key)
+        if fn is None:
+            fn = jax.jit(lambda kv, idx, data: kv.at[:, idx].set(data),
+                         donate_argnums=(0,))
+            self._scatter_fns[key] = fn
+        return fn
+
+    def _payload_placement(self):
+        """device_put target for restore/promote payloads — the pool's
+        NamedSharding under a mesh (the import RE-SHARDS host bytes onto
+        the local topology), the pool device otherwise."""
+        return getattr(self.pool, "placement", self.pool.device)
 
     # -- lane swap (preemption) ----------------------------------------------
     def swap_out(self, pages: List[int], length: int, kv,
@@ -159,7 +212,7 @@ class KVOffloadManager:
             n = len(pages)
             idx = np.zeros((_next_pow2(n),), np.int32)  # pad -> scratch 0
             idx[:n] = pages
-            gathered = self._gather(kv, idx)
+            gathered = self._gather_fn(idx.shape[0])(kv, idx)
         except Exception as e:  # noqa: BLE001 - degrade, never corrupt
             self.swap_failures += 1
             log.warning("KV swap-out degraded to recompute path: %s: %s",
@@ -248,14 +301,14 @@ class KVOffloadManager:
                 pad = np.broadcast_to(
                     zero, (arr.shape[0], idx.shape[0] - n) + arr.shape[2:])
                 arr = np.concatenate([arr, pad], axis=1)
-            data = jax.device_put(arr, self.pool.device)
+            data = jax.device_put(arr, self._payload_placement())
         except Exception as e:  # noqa: BLE001 - pre-dispatch: degrade
             self.swap_failures += 1
             self.store.remove(handle.key)
             log.warning("KV swap-in degraded to re-prefill: %s: %s",
                         type(e).__name__, str(e)[:200])
             return None
-        new_kv = self._scatter(kv, idx, data)
+        new_kv = self._scatter_fn(idx.shape[0])(kv, idx, data)
         self.swap_ins += 1
         self.swap_in_bytes += handle.n_pages * self.page_nbytes
         self.recompute_tokens_saved += handle.length
@@ -313,7 +366,7 @@ class KVOffloadManager:
         try:
             if chaos.trip("kvcache.swap") == "drop":
                 raise chaos.ChaosError("injected swap drop")
-            gathered = self._gather(kv, np.asarray([page], np.int32))
+            gathered = self._gather_fn(1)(kv, np.asarray([page], np.int32))
         except Exception as e:  # noqa: BLE001 - the entry just drops
             self.swap_failures += 1
             log.warning("prefix demotion skipped: %s: %s",
@@ -358,13 +411,13 @@ class KVOffloadManager:
             arr = self.store.pop(("px", digest))
             if arr is None:
                 return None
-            data = jax.device_put(arr, self.pool.device)
+            data = jax.device_put(arr, self._payload_placement())
         except Exception as e:  # noqa: BLE001 - pre-dispatch: degrade
             self.swap_failures += 1
             log.warning("prefix promotion degraded to recompute: %s: %s",
                         type(e).__name__, str(e)[:200])
             return None
-        new_kv = self._scatter(kv, np.asarray([page], np.int32), data)
+        new_kv = self._scatter_fn(1)(kv, np.asarray([page], np.int32), data)
         self.promotions += 1
         self.swap_in_bytes += self.page_nbytes
         if self.metrics is not None:

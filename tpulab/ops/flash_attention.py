@@ -99,7 +99,9 @@ def _flash_bhd(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q,), jnp.float32),      # running normalizer
             pltpu.VMEM((block_q, d), jnp.float32),    # running numerator
         ],
-        compiler_params=pltpu.CompilerParams(
+        # CompilerParams was TPUCompilerParams on older jax (0.4.x)
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)

@@ -25,6 +25,7 @@ first-class here because multi-chip scaling shapes the core design:
 
 from tpulab.parallel.mesh import make_mesh, default_mesh
 from tpulab.parallel.sharding import (
+    kv_pool_sharding,
     named_sharding,
     replicate,
     shard_batch,
@@ -36,7 +37,7 @@ from tpulab.parallel.checkpoint import TrainCheckpointer, abstract_like
 __all__ = [
     "make_mesh", "default_mesh",
     "named_sharding", "replicate", "shard_batch",
-    "transformer_param_shardings",
+    "kv_pool_sharding", "transformer_param_shardings",
     "MultiDeviceDispatcher",
     "TrainCheckpointer", "abstract_like",
 ]

@@ -15,6 +15,16 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def shard_map(body, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: the top-level name only
+    exists on newer jax; older versions (this image ships 0.4.x) carry
+    it as ``jax.experimental.shard_map.shard_map``."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
@@ -26,6 +36,18 @@ def replicate(mesh: Mesh) -> NamedSharding:
 def shard_batch(mesh: Mesh, axis: str = "data") -> NamedSharding:
     """Batch-dim sharding for activations/inputs (DP)."""
     return NamedSharding(mesh, P(axis))
+
+
+def kv_pool_sharding(mesh: Mesh, model_axis: str = "model") -> NamedSharding:
+    """Paged-KV page-store sharding: the pool's fused layout is
+    ``(n_layers, n_pages, 2, page_size, n_kv_heads, head_dim)`` and the
+    page *payloads* shard over the model axis on the KV-heads dim (axis
+    4) — matching the column-parallel ``wqkv`` that produces them, so a
+    sharded decode step scatters/gathers its own heads with no
+    resharding.  Page *tables* (host-side int32 id maps) stay
+    replicated.  The same spec places swap payloads
+    ``(n_layers, n, 2, page_size, n_kv_heads, head_dim)``."""
+    return NamedSharding(mesh, P(None, None, None, None, model_axis, None))
 
 
 def transformer_param_shardings(params: Dict[str, Any], mesh: Mesh,

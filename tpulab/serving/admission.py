@@ -255,7 +255,13 @@ class AdmissionController:
         """Cost-aware dispatch gate: the load source must have the free KV
         pages to hold ``cost`` tokens and lane headroom to schedule the
         request soon (at most one lane-set's worth queued inside the
-        engine — the admission queue is where waiting happens)."""
+        engine — the admission queue is where waiting happens).
+
+        Mesh-invariant by construction (docs/SERVING.md): under sharded
+        serving page *tables* are replicated and page *payloads* split
+        over the model axis, so counting LOGICAL free pages is already
+        the per-shard headroom — one free page is page_nbytes/M bytes
+        free on every shard at once."""
         eng = self._load
         if eng is None:
             return True

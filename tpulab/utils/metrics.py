@@ -343,6 +343,14 @@ class GenerationMetrics:
             "Lanes degraded from speculative to plain decode blocks "
             "(low acceptance, chaos verify trips)",
             registry=self.registry)
+        self.spec_probes = Counter(
+            f"{ns}_llm_spec_probes",
+            "Probe blocks re-trying speculation on a transiently degraded "
+            "lane (acceptance-EWMA degrades only)", registry=self.registry)
+        self.spec_probe_recoveries = Counter(
+            f"{ns}_llm_spec_probe_recoveries",
+            "Probe blocks whose lane recovered to speculative decode "
+            "(acceptance back above the floor)", registry=self.registry)
         self.spec_acceptance_rate = Gauge(
             f"{ns}_llm_spec_acceptance_rate",
             "Lifetime draft acceptance rate (accepted / drafted) — the "
@@ -416,6 +424,10 @@ class GenerationMetrics:
         self._advance(self.spec_tokens_accepted, "spec_accepted", accepted)
         self._advance(self.spec_fallbacks, "spec_fallbacks",
                       getattr(batcher, "spec_fallbacks", 0))
+        self._advance(self.spec_probes, "spec_probes",
+                      getattr(batcher, "spec_probes", 0))
+        self._advance(self.spec_probe_recoveries, "spec_probe_recoveries",
+                      getattr(batcher, "spec_probe_recoveries", 0))
         if drafted:
             self.spec_acceptance_rate.set(accepted / drafted)
         if dispatches:
