@@ -859,6 +859,23 @@ def main() -> None:
     except Exception as e:
         print(f"# disagg row skipped: {e!r}", file=sys.stderr)
 
+    # durable token streams (docs/ROBUSTNESS.md "Stream failover
+    # semantics"): a chaos mid-stream kill at token N over two loopback
+    # replicas, resume-from-delivered ON vs OFF.  The claim tracked: with
+    # resume ON the survivor pays one chunked prefill and replayed tokens
+    # collapse to zero; OFF re-pays every delivered token.  On CPU jit
+    # the replay/prefill counts are the signal; on-device the recovery
+    # gap (dead air between last pre-kill and first post-kill token) is.
+    _phase("failover_recovery")
+    try:
+        from tpulab.rpc.replica import benchmark_failover_recovery
+        _record(failover_recovery=benchmark_failover_recovery(
+            prompt_len=16 if degraded else 24,
+            steps=16 if degraded else 24,
+            kill_at=5 if degraded else 8))
+    except Exception as e:
+        print(f"# failover recovery row skipped: {e!r}", file=sys.stderr)
+
     # admission control under overload (docs/SERVING.md): offer ~2x the
     # measured capacity with per-request deadlines and record goodput
     # (deadline-met completions/s), shed rate, and p99 admission queue

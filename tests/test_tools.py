@@ -122,6 +122,14 @@ def test_gen_inference_pb2_schema_drift_and_roundtrip():
     assert dr.final and dr.kv_shipment == b"snap"
     assert pb.GenerateRequest().kv_shipment == b""  # absent = no shipment
 
+    # durable streams (docs/ROBUSTNESS.md "Stream failover semantics"):
+    # resume_length rides the request — prompt already holds the
+    # delivered tokens, the server emits from index resume_length
+    rr = pb.GenerateRequest.FromString(pb.GenerateRequest(
+        prompt=[1, 2, 9, 4], steps=8, resume_length=2).SerializeToString())
+    assert rr.resume_length == 2
+    assert pb.GenerateRequest().resume_length == 0  # absent = fresh request
+
 
 # -- capture policy (stubbed attempts; no device needed) ----------------------
 def _bc(monkeypatch, recs):

@@ -161,6 +161,17 @@ def build_file() -> dp.FileDescriptorProto:
         # through the host tier instead of prefilling
         field("prefill_only", 15, F.TYPE_BOOL),
         field("kv_shipment", 16, F.TYPE_BYTES),
+        # durable streams (docs/ROBUSTNESS.md "Stream failover
+        # semantics"): a failover RESUME.  The prompt already contains
+        # original_prompt + the resume_length tokens the client delivered
+        # before the replica died; the server prefills the whole thing
+        # (one chunked prefill, zero per-token re-decode of delivered
+        # tokens) and emits from index resume_length with absolute
+        # positions preserved — bit-exact for greedy and device-sampled
+        # streams ((seed, position)-keyed).  Host-sampled requests are
+        # rejected (draw-order PRNG does not survive the hop); 0 = a
+        # fresh request.
+        field("resume_length", 17, F.TYPE_INT32),
     ])
     m.oneof_decl.add(name="_seed")
 
@@ -254,6 +265,11 @@ def main() -> int:
         "dr = pb.GenerateResponse(final=True, kv_shipment=b'wire');"
         "dr = pb.GenerateResponse.FromString(dr.SerializeToString());"
         "assert dr.kv_shipment == b'wire';"
+        "rr = pb.GenerateRequest(prompt=[1, 2, 9], steps=8,"
+        " resume_length=2);"
+        "rr = pb.GenerateRequest.FromString(rr.SerializeToString());"
+        "assert rr.resume_length == 2;"
+        "assert pb.GenerateRequest().resume_length == 0;"
         "r2 = pb.GenerateRequest();"
         "assert not r2.HasField('seed');"
         "r2.seed = 9; assert r2.HasField('seed');"

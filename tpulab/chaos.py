@@ -47,6 +47,14 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
     rpc.client.unary          ClientUnary.start, before the call (drop-capable)
     rpc.client.stream_recv    ClientStreaming read loop, per response
     rpc.server.generate_token GenerateContext dense loop, per token (kill site)
+    rpc.stream                GenerateContext token-EMIT site, per token
+                              (dense AND paged paths) — error kills the
+                              stream mid-flight with a retryable INTERNAL
+                              (clients fail over, resuming from delivered);
+                              delay slows the emit; drop latches the stream
+                              STALLED: it stops emitting but stays open
+                              with no final, exactly what the inter-token
+                              stall watchdog exists to catch
     serving.admission         AdmissionController.admit — error/drop force a
                               RESOURCE_EXHAUSTED rejection (synthetic
                               overload), delay models a slow decision

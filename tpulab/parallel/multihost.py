@@ -84,6 +84,30 @@ def barrier(mesh=None) -> None:
     assert int(total) == len(mesh.devices.flat)
 
 
+def supports_multiprocess_collectives(mesh=None) -> bool:
+    """Explicit capability probe: can THIS backend actually run a
+    cross-process collective?  Some backends register multiple processes
+    but reject multi-process computations at dispatch (the CPU backend:
+    "Multiprocess computations aren't implemented") — tests that need a
+    real cross-host collective skip on False instead of failing on an
+    environment hole.
+
+    Single-process jobs trivially support it (nothing crosses a process
+    boundary).  Returns False ONLY for the backend's explicit
+    not-implemented rejection; any other failure propagates — a hang, a
+    wrong result or an unrelated error is a regression, never a skip."""
+    import jax
+    if jax.process_count() <= 1:
+        return True
+    try:
+        barrier(mesh)
+        return True
+    except Exception as e:  # noqa: BLE001 - inspect, re-raise non-capability
+        if "implemented" in str(e):
+            return False
+        raise
+
+
 def local_data_slice(global_batch: int, mesh=None) -> Tuple[int, int]:
     """[start, stop) rows of the global batch this process should feed
     (data axis is outermost, so rows map contiguously to processes).

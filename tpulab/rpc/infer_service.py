@@ -598,6 +598,11 @@ class GenerateContext(StreamingContext):
                 code=pb.INVALID_ARGUMENT,
                 message=f"prompt token ids outside [0, {vocab})")))
             return
+        msg = self._validate_resume(request)
+        if msg is not None:
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT, message=msg)))
+            return
         deadline = self._deadline_of(request)
         ticket = None
         if res.admission is not None:
@@ -609,6 +614,56 @@ class GenerateContext(StreamingContext):
         finally:
             if ticket is not None:
                 ticket.release()
+
+    @staticmethod
+    def _validate_resume(request: pb.GenerateRequest) -> Optional[str]:
+        """Deterministic validation of a resume-from-delivered failover
+        request (docs/ROBUSTNESS.md "Stream failover semantics").  The
+        prompt must already contain original_prompt + the delivered
+        tokens, and the sampling stream must be (seed, position)-keyed —
+        greedy or device sampling — so the continuation is bit-exact.
+        Host-sampled requests are REJECTED here (their PRNG is keyed by
+        draw order, which does not survive the replica hop; same rule as
+        shipped-KV admission) and the client degrades to a full replay.
+        Returns an error message, or None when the request is fine."""
+        resume = int(request.resume_length)
+        if resume == 0:
+            return None
+        if resume < 0:
+            return "resume_length must be >= 0"
+        if resume >= request.steps:
+            return (f"resume_length {resume} must be < steps "
+                    f"{request.steps} (nothing left to generate)")
+        if len(request.prompt) <= resume:
+            return ("resume prompt must contain the original prompt plus "
+                    f"the {resume} delivered tokens")
+        if request.temperature > 0.0 and not request.device_sampling:
+            return ("resume requires greedy or device sampling (host-side "
+                    "PRNG draw order does not survive the replica hop)")
+        if request.prefill_only or request.kv_shipment:
+            return ("resume_length cannot combine with prefill_only/"
+                    "kv_shipment (disaggregation fields)")
+        return None
+
+    def _note_resume(self, engine, request: pb.GenerateRequest) -> None:
+        """Server-side resume observability: the delivered prefix rides
+        one chunked prefill instead of per-token re-decode dispatches."""
+        m = getattr(engine, "metrics", None)
+        if m is not None and hasattr(m, "note_resume"):
+            m.note_resume(int(request.resume_length))
+
+    def _hold_stalled_stream(self, until_monotonic: float) -> None:
+        """A chaos ``rpc.stream=drop`` latched this stream STALLED: keep
+        the RPC open without emitting (what a wedged emit path looks like
+        to the client) until the client gives up or the lease cap passes.
+        Deterministically drivable stall for the inter-token watchdog."""
+        import time as _time
+        while _time.monotonic() < until_monotonic:
+            g = self.grpc_context
+            if (g is not None and hasattr(g, "is_active")
+                    and not g.is_active()):
+                return
+            _time.sleep(0.02)
 
     def _admit(self, request: pb.GenerateRequest, res: InferResources,
                deadline):
@@ -629,6 +684,12 @@ class GenerateContext(StreamingContext):
         elif request.prefill_only:
             # prefill-role request: prompt forward only, one token out
             cost = len(request.prompt) + 1
+        elif request.resume_length:
+            # resume-from-delivered failover: the prompt (which already
+            # contains the delivered tokens) is one chunked prefill, and
+            # only the REMAINING tokens decode sequentially
+            cost = (len(request.prompt)
+                    + max(1, request.steps - request.resume_length))
         else:
             cost = len(request.prompt) + request.steps
         try:
@@ -684,6 +745,16 @@ class GenerateContext(StreamingContext):
                 trace.add_span(name, t0, dur, **targs, **extra)
         try:
             stops = set(request.stop_tokens)
+            # resume-from-delivered failover (greedy-only engine, so every
+            # dense request is eligible): the prompt already contains the
+            # delivered tokens — prefill it whole, then emit the REMAINING
+            # steps from index resume_length (absolute positions preserved,
+            # so the greedy continuation is bit-exact)
+            resume_ofs = int(request.resume_length)
+            steps_eff = request.steps - resume_ofs
+            if resume_ofs:
+                self._note_resume(engine, request)
+            stalled = False
             t_lease0 = _time.perf_counter()
             with engine.start_session(
                     timeout=self.SESSION_LEASE_TIMEOUT_S) as session:
@@ -700,7 +771,7 @@ class GenerateContext(StreamingContext):
                     # (retryable) below.
                     t0 = _time.perf_counter()
                     session.prefill(np.asarray(request.prompt, np.int32))
-                    stream = session.stream(request.steps)
+                    stream = session.stream(steps_eff)
                     span("prefill", t0, _time.perf_counter() - t0,
                          prompt_tokens=len(request.prompt))
                 except ValueError as e:
@@ -739,14 +810,27 @@ class GenerateContext(StreamingContext):
                     # chaos: per-token server fault site (error = transient
                     # stream failure; kill = replica process death)
                     chaos.trip("rpc.server.generate_token")
-                    self.write(pb.GenerateResponse(token=tok, index=i))
+                    # chaos: the token-EMIT site (error = mid-stream fault
+                    # the client fails over from; drop = the emit path
+                    # wedges and the stream STALLS open without progress
+                    # — the inter-token watchdog's territory)
+                    if chaos.trip("rpc.stream") == "drop":
+                        stalled = True
+                        flush_chunk(i)
+                        break
+                    self.write(pb.GenerateResponse(token=tok,
+                                                   index=resume_ofs + i))
                     if (i + 1) % TRACE_DECODE_CHUNK == 0:
                         flush_chunk(i + 1)
                     if tok in stops:
                         flush_chunk(i + 1)
                         break  # stop token emitted; end like the paged path
                 else:
-                    flush_chunk(request.steps)
+                    flush_chunk(steps_eff)
+            if stalled:
+                self._hold_stalled_stream(
+                    _time.monotonic() + self.SESSION_LEASE_TIMEOUT_S)
+                return  # no final: the stream died stalled, never resolved
             t0 = _time.perf_counter()
             self.write(pb.GenerateResponse(
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
@@ -842,12 +926,34 @@ class GenerateContext(StreamingContext):
             self._run_prefill_export(engine, request, deadline)
             return
         finished = [False]
+        # resume-from-delivered failover (docs/ROBUSTNESS.md "Stream
+        # failover semantics"): the prompt already contains the delivered
+        # tokens, so the engine admits it through the ordinary (chunked)
+        # prefill path and only the remaining steps decode; emitted
+        # indices shift by resume_length so the client stream continues
+        # seamlessly.  Absolute positions are preserved by construction —
+        # the (seed, position)-keyed sampling streams are bit-exact.
+        resume_ofs = int(request.resume_length)
+        steps_eff = request.steps - resume_ofs
+        if resume_ofs:
+            self._note_resume(engine, request)
+        stalled = [False]     # chaos rpc.stream drop: emit path wedged
+        stream_fault = []     # chaos rpc.stream error: mid-stream fault
 
         def on_token(tok, i, logprob=None):
-            if not finished[0]:
-                self.write(pb.GenerateResponse(
-                    token=tok, index=i,
-                    logprob=0.0 if logprob is None else float(logprob)))
+            if finished[0] or stalled[0] or stream_fault:
+                return
+            # chaos: the token-EMIT site (see the dense loop's twin trip)
+            try:
+                if chaos.trip("rpc.stream") == "drop":
+                    stalled[0] = True
+                    return
+            except chaos.ChaosError as e:
+                stream_fault.append(e)
+                return
+            self.write(pb.GenerateResponse(
+                token=tok, index=resume_ofs + i,
+                logprob=0.0 if logprob is None else float(logprob)))
 
         fut = None
         res = self.get_resources(InferResources)
@@ -894,7 +1000,7 @@ class GenerateContext(StreamingContext):
                                     "to local prefill: %s", e)
             if fut is None:
                 fut = engine.submit(np.asarray(request.prompt, np.int32),
-                                    request.steps, on_token=on_token,
+                                    steps_eff, on_token=on_token,
                                     sampling=sampling,
                                     priority=request.priority,
                                     stop_tokens=list(request.stop_tokens),
@@ -907,6 +1013,8 @@ class GenerateContext(StreamingContext):
                 except DeadlineExceeded:
                     raise  # NOT a poll timeout (TimeoutError subclass!)
                 except _f.TimeoutError:
+                    if stream_fault:
+                        raise stream_fault[0]  # injected mid-stream fault
                     if _time.monotonic() > lease_deadline:
                         raise
                     if (self.grpc_context is not None
@@ -915,6 +1023,15 @@ class GenerateContext(StreamingContext):
                         engine.cancel(fut)  # client gone: free the lane
                         finished[0] = True
                         return
+            if stream_fault:
+                raise stream_fault[0]
+            if stalled[0]:
+                # emit path wedged (chaos rpc.stream drop): hold the RPC
+                # open WITHOUT a final so the client sees a stalled — not
+                # dead — replica and its inter-token watchdog must act
+                finished[0] = True
+                self._hold_stalled_stream(lease_deadline)
+                return
             finished[0] = True
             self.write(pb.GenerateResponse(
                 final=True, status=pb.RequestStatus(code=pb.SUCCESS)))
@@ -979,6 +1096,22 @@ class ResourceExhausted(GenerationRejected):
         self.retry_after_ms = int(retry_after_ms)
 
 
+class StreamStalled(TimeoutError):
+    """The generation stream stopped making progress within its stall
+    bound: no FIRST token within ``ttft_timeout``, or no next token
+    within ``inter_token_timeout`` (docs/ROBUSTNESS.md "Stream failover
+    semantics").  A ``TimeoutError`` subclass so generic timeout handling
+    survives, but a distinct evidence class: replica routers count a
+    stall separately (``stalls``), feed it to the circuit breaker, and
+    fail the stream over (with resume) in seconds instead of waiting out
+    the coarse per-activity ``timeout``."""
+
+    def __init__(self, message: str, phase: str = "inter_token"):
+        super().__init__(message)
+        #: ``"ttft"`` (no first token) or ``"inter_token"`` (mid-stream)
+        self.phase = phase
+
+
 class GenerateStreamClient:
     """Client: ``generate(prompt, steps)`` yields tokens as they stream."""
 
@@ -996,6 +1129,10 @@ class GenerateStreamClient:
                  tenant_id: Optional[str] = None,
                  kv_shipment: Optional[bytes] = None,
                  prefill_only: bool = False,
+                 resume_length: int = 0,
+                 ttft_timeout: Optional[float] = None,
+                 inter_token_timeout: Optional[float] = None,
+                 _cancel_evt=None,
                  _final: Optional[list] = None):
         """Yields token ids; with ``return_logprobs=True`` yields
         ``(token, logprob)`` pairs instead.
@@ -1019,8 +1156,25 @@ class GenerateStreamClient:
         (degrades server-side to local prefill when unusable);
         ``prefill_only=True`` asks for the prompt prefill + first token
         only (use :meth:`prefill_export`, which also returns the
-        shipment).  ``_final`` (private) receives the final
-        GenerateResponse for callers that need its fields."""
+        shipment).
+
+        Durable streams (docs/ROBUSTNESS.md "Stream failover semantics"):
+        ``resume_length=N`` marks this request a failover RESUME — the
+        prompt must already contain original_prompt + the N delivered
+        tokens; the server prefills it whole (one chunked prefill, zero
+        per-token re-decode of the delivered prefix) and emits from index
+        N, bit-exact for greedy/device-sampled streams (host-sampled is
+        rejected INVALID_ARGUMENT).  ``ttft_timeout`` /
+        ``inter_token_timeout`` split the stall bound: no FIRST response
+        within ``ttft_timeout`` (default: ``timeout``), or no next
+        response within ``inter_token_timeout`` (default: ``timeout``),
+        raises :class:`StreamStalled` — a hung dispatch fails over in
+        seconds instead of the coarse per-activity ``timeout``.
+        ``_cancel_evt`` (private, a ``threading.Event``) makes the wait
+        loop poll in short slices and end the stream promptly when set —
+        the hedged-attempt loser-cancellation hook.  ``_final`` (private)
+        receives the final GenerateResponse for callers that need its
+        fields."""
         import queue as _q
         deadline = Deadline.after(deadline_s)
         out: "_q.Queue" = _q.Queue()
@@ -1061,6 +1215,8 @@ class GenerateStreamClient:
             req.kv_shipment = kv_shipment
         if prefill_only:
             req.prefill_only = True
+        if resume_length:
+            req.resume_length = int(resume_length)
         rem = deadline.remaining()
         if rem is not None:
             # RELATIVE budget, never wall clock: replica clocks differ
@@ -1068,17 +1224,53 @@ class GenerateStreamClient:
         stream.write(req)
         stream.writes_done()
         finished = False
+        got_first = False
+
+        def _next_response():
+            """One queue read under the phase's stall bound (TTFT before
+            the first response, inter-token after), sliced into short
+            polls when a hedge cancel event is watching."""
+            bound = (ttft_timeout if not got_first
+                     else inter_token_timeout)
+            if bound is None:
+                bound = timeout
+            eff = deadline.bound(bound)
+            if _cancel_evt is None:
+                try:
+                    return out.get(timeout=eff)
+                except _q.Empty:
+                    deadline.check("generation")
+                    raise StreamStalled(
+                        f"no generation stream activity within {bound}s "
+                        f"({'TTFT' if not got_first else 'inter-token'} "
+                        "stall bound)",
+                        phase="ttft" if not got_first else "inter_token")
+            import time as _t
+            t_end = None if eff is None else _t.monotonic() + eff
+            while True:
+                if _cancel_evt.is_set():
+                    return None  # lost the hedge race: end quietly
+                slice_s = 0.05
+                if t_end is not None:
+                    slice_s = min(slice_s, max(0.001, t_end - _t.monotonic()))
+                try:
+                    return out.get(timeout=slice_s)
+                except _q.Empty:
+                    if t_end is not None and _t.monotonic() >= t_end:
+                        deadline.check("generation")
+                        raise StreamStalled(
+                            f"no generation stream activity within "
+                            f"{bound}s", phase=("ttft" if not got_first
+                                                else "inter_token"))
         try:
             while True:
                 deadline.check("generation")
-                try:
-                    resp = out.get(timeout=deadline.bound(timeout))
-                except _q.Empty:
-                    # finished stays False: the finally-cancel tears the
-                    # stalled stream down and frees the server slot
-                    deadline.check("generation")
-                    raise TimeoutError(
-                        f"no generation stream activity within {timeout}s")
+                # finished stays False on a stall: the finally-cancel
+                # tears the stalled stream down and frees the server slot
+                resp = _next_response()
+                if resp is None:  # _cancel_evt set: cancelled, not failed
+                    return
+                got_first = True
                 if resp is _STREAM_DEAD:
                     finished = True
                     exc = stream.done().exception()

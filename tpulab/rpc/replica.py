@@ -71,6 +71,11 @@ def _status_code_of(exc: Optional[BaseException]) -> str:
         return "OK"
     if isinstance(exc, DeadlineExceeded):
         return "DEADLINE_EXCEEDED"
+    from tpulab.rpc.infer_service import StreamStalled
+    if isinstance(exc, StreamStalled):
+        # the stall watchdog's distinct evidence class: a replica that
+        # stopped emitting is not the same signal as one that refused
+        return "STALLED"
     from tpulab.rpc.infer_service import GenerationRejected
     if isinstance(exc, GenerationRejected):
         from tpulab.rpc.protos import inference_pb2 as pb
@@ -204,14 +209,16 @@ class _BaseReplicaSet:
 
     def _attempt_span(self, start_s: float, idx: int, attempt: int,
                       trace_id: Optional[str],
-                      exc: Optional[BaseException]) -> None:
-        """One client-side attempt span (tagged attempt + replica + code)."""
+                      exc: Optional[BaseException], **extra) -> None:
+        """One client-side attempt span (tagged attempt + replica + code;
+        replay/resume attempts add ``resumed_from=`` + ``mode=`` so the
+        merged timeline shows where a stream migrated)."""
         tr = self.trace
         if tr is None:
             return
         import time as _t
         args = {"replica": self.addresses[idx], "attempt": attempt,
-                "code": _status_code_of(exc)}
+                "code": _status_code_of(exc), **extra}
         if trace_id:
             args["trace_id"] = trace_id
         tr.add_span("attempt", start_s, _t.perf_counter() - start_s, **args)
@@ -653,13 +660,40 @@ class GenerationReplicaSet(_BaseReplicaSet):
     device-sampled requests prefill on a prefill-role replica, whose
     finished KV ships over the host tier's wire form to a decode-role
     replica picked by the same load gauges; every hole in the path
-    degrades to the unified routing with exactly-once delivery."""
+    degrades to the unified routing with exactly-once delivery.
+
+    Durable streams (docs/ROBUSTNESS.md "Stream failover semantics"):
+
+    - **Resume-from-delivered failover** (``resume_failover=True``, the
+      default): a mid-stream replica death resubmits
+      ``prompt + delivered_tokens`` with ``resume_length=len(delivered)``
+      — the surviving replica pays ONE chunked prefill instead of
+      re-decoding the delivered prefix token by token, and emits from
+      index ``resume_length``.  Bit-exact for greedy AND device-sampled
+      streams (both key their sampling by (seed, position)); host-sampled
+      requests are rejected server-side and the client degrades to
+      today's full replay (delivered tokens re-received and skipped).
+    - **Stall watchdog** (``ttft_timeout_s`` / ``inter_token_timeout_s``,
+      per-call overridable): a replica that stops emitting — as opposed
+      to dying — fails over within the inter-token bound instead of the
+      coarse per-activity ``timeout``, counted as the distinct
+      ``stalled`` evidence class feeding the circuit breaker.
+    - **Hedged first token** (``hedge_delay_s``, default off): when the
+      primary attempt produces no first token within the hedge delay,
+      ONE duplicate attempt launches on another replica; first writer
+      wins and the loser is cancelled through the existing cancel path.
+      Never for host-sampled requests, and skipped while any replica is
+      in overload backoff (a hedge must not amplify an overload)."""
 
     def __init__(self, addresses: Sequence[str], model_name: str,
                  channels: int = 1, max_failover: Optional[int] = None,
                  prefix_affinity: bool = False, affinity_tokens: int = 32,
                  affinity_slack: int = 2, metrics=None,
-                 disaggregate: bool = False, **breaker_kw):
+                 disaggregate: bool = False,
+                 resume_failover: bool = True,
+                 ttft_timeout_s: Optional[float] = None,
+                 inter_token_timeout_s: Optional[float] = None,
+                 hedge_delay_s: Optional[float] = None, **breaker_kw):
         super().__init__(addresses, model_name, channels, max_failover,
                          metrics=metrics, **breaker_kw)
         self._clients = [GenerateStreamClient(m, model_name)
@@ -667,6 +701,22 @@ class GenerationReplicaSet(_BaseReplicaSet):
         self.prefix_affinity = prefix_affinity
         self.affinity_tokens = affinity_tokens
         self.affinity_slack = affinity_slack
+        #: resubmit failovers as resume-from-delivered when the sampling
+        #: stream survives the hop (False = always full replay)
+        self.resume_failover = resume_failover
+        #: stall watchdog defaults (None = fall back to the per-activity
+        #: ``timeout``); per-call kwargs override
+        self.ttft_timeout_s = ttft_timeout_s
+        self.inter_token_timeout_s = inter_token_timeout_s
+        #: hedge delay for the duplicate first-token attempt (None = off)
+        self.hedge_delay_s = hedge_delay_s
+        #: durable-stream counters (observability / test assertions)
+        self.stalls = 0            # watchdog-detected stalled streams
+        self.resumes = 0           # failover attempts resubmitted as resume
+        self.resume_fallbacks = 0  # server-rejected resumes -> full replay
+        self.tokens_replayed = 0   # delivered tokens re-received + skipped
+        self.hedges = 0            # duplicate first-token attempts launched
+        self.hedge_wins = 0        # hedges whose duplicate won the race
         #: role-aware disaggregated routing (docs/SERVING.md "Replica
         #: roles"): new requests go to a prefill-role replica first, the
         #: finished prefill's KV shipment is handed to a decode-role
@@ -727,6 +777,12 @@ class GenerationReplicaSet(_BaseReplicaSet):
         ``trace_id`` (optional) joins this request to an existing trace;
         by default one is minted per request — all failover attempts and
         the server-side spans they produce share it (utils.tracing).
+
+        ``ttft_timeout`` / ``inter_token_timeout`` (optional; default to
+        the set-level ``ttft_timeout_s`` / ``inter_token_timeout_s``,
+        else ``timeout``) are the stall watchdog's split bounds: a stream
+        with no first token / no next token inside its bound fails over
+        (with resume) instead of waiting out the activity ``timeout``.
         """
         import numpy as np
         if kw.get("temperature", 0.0) and kw.get("seed") is None:
@@ -734,6 +790,10 @@ class GenerationReplicaSet(_BaseReplicaSet):
             kw["seed"] = secrets.randbits(63)
         if deadline_s is not None:
             kw["deadline_s"] = deadline_s
+        if self.ttft_timeout_s is not None:
+            kw.setdefault("ttft_timeout", self.ttft_timeout_s)
+        if self.inter_token_timeout_s is not None:
+            kw.setdefault("inter_token_timeout", self.inter_token_timeout_s)
         prompt = list(np.asarray(prompt, np.int32))
         if (self.disaggregate and not kw.get("return_logprobs")
                 and (not kw.get("temperature")
@@ -742,12 +802,89 @@ class GenerationReplicaSet(_BaseReplicaSet):
             # survive the replica hop; host-sampled + logprob requests
             # stay on the unified path
             return self._generate_disagg(prompt, steps, timeout, kw)
+        if self._hedge_eligible(kw):
+            return self._generate_hedged(prompt, steps, timeout, kw)
         return self._generate_iter(prompt, steps, timeout, kw)
 
+    # -- durable-stream bookkeeping (counters + optional metrics) -----------
+    def _stream_survives_hop(self, kw: dict) -> bool:
+        """Greedy and device-sampled streams are keyed by (seed,
+        position) and continue bit-exact on another replica; host-sampled
+        streams are keyed by PRNG draw order and do not survive."""
+        return not kw.get("temperature", 0.0) or bool(
+            kw.get("device_sampling"))
+
+    def _note_stall(self) -> None:
+        self.stalls += 1
+        m = self._metrics
+        if m is not None and hasattr(m, "note_stall"):
+            m.note_stall()
+
+    def _note_resume(self) -> None:
+        self.resumes += 1
+        m = self._metrics
+        if m is not None and hasattr(m, "note_resume"):
+            m.note_resume()
+
+    def _note_resume_fallback(self) -> None:
+        self.resume_fallbacks += 1
+        m = self._metrics
+        if m is not None and hasattr(m, "note_resume_fallback"):
+            m.note_resume_fallback()
+
+    def _note_replayed(self, n: int) -> None:
+        self.tokens_replayed += n
+        m = self._metrics
+        if n > 0 and m is not None and hasattr(m, "note_tokens_replayed"):
+            m.note_tokens_replayed(n)
+
+    def _dispose_failure(self, idx: int, exc: BaseException) -> str:
+        """Shared attempt-failure bookkeeping for the hedged path:
+        records overload/stall/fault evidence and says whether failover
+        may follow (``"failover"``) or the error is terminal
+        (``"raise"``)."""
+        from tpulab.rpc.infer_service import (GenerationRejected,
+                                              ResourceExhausted,
+                                              StreamStalled)
+        if isinstance(exc, DeadlineExceeded):
+            return "raise"  # global budget: no replica can beat it
+        if isinstance(exc, ResourceExhausted):
+            self._record_overload(idx, exc.retry_after_ms)
+            return "failover"
+        if isinstance(exc, GenerationRejected) and not exc.retryable:
+            self._record_success(idx)  # deterministic rejection: the
+            return "raise"             # replica itself is fine
+        if isinstance(exc, StreamStalled):
+            self._note_stall()
+        self._record_failure(idx)
+        return "failover"
+
+    def _hedge_eligible(self, kw: dict) -> bool:
+        """Hedge only when it cannot hurt: never host-sampled (the
+        duplicate's PRNG stream would not be the same request), never
+        with a single replica, and never while ANY replica is in
+        overload backoff — a hedge under overload is the amplification
+        admission control exists to prevent."""
+        if self.hedge_delay_s is None or len(self._managers) < 2:
+            return False
+        if not self._stream_survives_hop(kw):
+            return False
+        now = time.monotonic()
+        with self._lock:
+            return not any(b > now for b in self._backoff_until)
+
     def _generate_iter(self, prompt, steps, timeout, kw,
-                       already_delivered: int = 0):
+                       already_delivered: int = 0,
+                       delivered_tokens: Optional[list] = None):
         deadline = Deadline.after(kw.pop("deadline_s", None))
         delivered = already_delivered
+        pairs = bool(kw.get("return_logprobs"))
+        #: delivered token VALUES — what a resume attempt appends to the
+        #: prompt.  A caller-provided count without the values (legacy
+        #: shape) pins the request to full replay.
+        toks: list = [int(t) for t in (delivered_tokens or [])]
+        resume_ok = (self.resume_failover and len(toks) == delivered
+                     and self._stream_survives_hop(kw))
         attempts_left = self._max_failover
         exclude: set = set()
         # one trace id for the logical request: every replay attempt (and
@@ -767,33 +904,54 @@ class GenerationReplicaSet(_BaseReplicaSet):
                 raise RuntimeError("no replicas")
             gen = None
             t_att = time.perf_counter()
+            # resume-from-delivered (docs/ROBUSTNESS.md "Stream failover
+            # semantics"): resubmit prompt+delivered so the replica pays
+            # one chunked prefill instead of re-decoding the delivered
+            # prefix; the emitted stream starts at index `delivered`.
+            use_resume = resume_ok and 0 < delivered < steps
+            span_extra = ({"resumed_from": delivered,
+                           "mode": "resume" if use_resume else "replay"}
+                          if delivered or attempt else {})
             try:
                 akw = dict(kw)
                 rem = deadline.remaining()
                 if rem is not None:
                     akw["deadline_s"] = rem  # per-attempt = what's left
+                a_prompt = prompt
+                if use_resume:
+                    a_prompt = list(prompt) + toks
+                    akw["resume_length"] = delivered
+                    self._note_resume()
                 gen = self._clients[idx].generate(
-                    prompt, steps, timeout=deadline.bound(timeout),
+                    a_prompt, steps, timeout=deadline.bound(timeout),
                     trace_id=trace_id, **akw)
-                i = 0
+                i = delivered if use_resume else 0
                 for item in gen:
                     if i >= delivered:  # replay skips what the consumer has
                         delivered += 1
+                        toks.append(int(item[0]) if pairs else int(item))
                         yield item
+                    else:
+                        # full-replay waste: a re-decoded, re-shipped token
+                        # the consumer already has
+                        self._note_replayed(1)
                     i += 1
                 with self._lock:
                     self.served[idx] += 1
                 self._record_success(idx)
                 self._note_served(idx)
                 self._note_attempt(None)
-                self._attempt_span(t_att, idx, attempt, trace_id, None)
+                self._attempt_span(t_att, idx, attempt, trace_id, None,
+                                   **span_extra)
                 self._note_deadline(True, deadline)
                 return
             except Exception as e:
                 self._note_attempt(e)
-                self._attempt_span(t_att, idx, attempt, trace_id, e)
+                self._attempt_span(t_att, idx, attempt, trace_id, e,
+                                   **span_extra)
                 from tpulab.rpc.infer_service import (GenerationRejected,
-                                                      ResourceExhausted)
+                                                      ResourceExhausted,
+                                                      StreamStalled)
                 if isinstance(e, ResourceExhausted):
                     # admission fast-fail: overload is not a dead replica
                     # (no breaker streak) — back this replica off and
@@ -817,6 +975,17 @@ class GenerationReplicaSet(_BaseReplicaSet):
                     exclude.clear()
                     continue
                 if isinstance(e, GenerationRejected) and not e.retryable:
+                    if use_resume and i == delivered:
+                        # the server refused the RESUME FORM before any
+                        # token (validation: e.g. a host-sampled request
+                        # reaching an eligibility hole, or a pre-resume
+                        # server) — the replica is fine; degrade this
+                        # request to full replay, exactly-once preserved
+                        self._record_success(idx)
+                        self._note_resume_fallback()
+                        resume_ok = False
+                        attempt += 1
+                        continue
                     # the server processed and rejected the request —
                     # identical on every replica, don't burn them all
                     # (and don't trip the breaker: the replica is fine)
@@ -825,6 +994,11 @@ class GenerationReplicaSet(_BaseReplicaSet):
                 if isinstance(e, DeadlineExceeded):
                     self._note_deadline(False, deadline)
                     raise  # global budget spent: no replica can beat it
+                if isinstance(e, StreamStalled):
+                    # the watchdog's distinct evidence class: a stalled —
+                    # not dead — replica, caught at the TTFT/inter-token
+                    # bound; still breaker evidence and still failed over
+                    self._note_stall()
                 self._record_failure(idx)
                 attempts_left -= 1
                 exclude.add(idx)
@@ -838,6 +1012,177 @@ class GenerationReplicaSet(_BaseReplicaSet):
                     self._note_inflight(idx)
                 if gen is not None:
                     gen.close()  # abandoned inner stream cancels promptly
+
+    # -- hedged first token (docs/ROBUSTNESS.md) -----------------------------
+    def _generate_hedged(self, prompt, steps, timeout, kw):
+        """First-token hedging: launch the primary attempt; if it shows
+        no first token within ``hedge_delay_s``, launch ONE duplicate on
+        another replica.  First writer wins, the loser is cancelled
+        through the existing cancel path (``_cancel_evt`` -> client
+        ``stream.cancel()`` -> the server frees the lane), and a winner
+        that later faults falls back to the ordinary failover loop with
+        resume — exactly-once token delivery throughout."""
+        import queue as _q
+        deadline = Deadline.after(kw.pop("deadline_s", None))
+        trace_id = kw.pop("trace_id", None) or mint_trace_id()
+        pairs = bool(kw.get("return_logprobs"))
+        events: "_q.Queue" = _q.Queue()
+
+        class _Attempt:
+            __slots__ = ("idx", "no", "cancel", "t0")
+
+            def __init__(self, idx, no):
+                self.idx = idx
+                self.no = no
+                self.cancel = threading.Event()
+                self.t0 = time.perf_counter()
+
+        def run(att: "_Attempt") -> None:
+            gen = None
+            try:
+                akw = dict(kw)
+                rem = deadline.remaining()
+                if rem is not None:
+                    akw["deadline_s"] = rem
+                gen = self._clients[att.idx].generate(
+                    prompt, steps, timeout=deadline.bound(timeout),
+                    trace_id=trace_id, _cancel_evt=att.cancel, **akw)
+                for item in gen:
+                    events.put(("tok", att, item))
+                events.put(("cancelled" if att.cancel.is_set() else "end",
+                            att, None))
+            except Exception as e:  # noqa: BLE001 - classified by consumer
+                events.put(("err", att, e))
+            finally:
+                if gen is not None:
+                    gen.close()
+                with self._lock:
+                    self._inflight[att.idx] -= 1
+                    self._note_inflight(att.idx)
+
+        def launch(no: int, exclude: set) -> Optional["_Attempt"]:
+            idx = self._pick_or_any(frozenset(exclude))
+            if idx is None:
+                return None
+            att = _Attempt(idx, no)
+            threading.Thread(target=run, args=(att,), daemon=True,
+                             name=f"gen-hedge-{no}").start()
+            return att
+
+        def unified_fallback(delivered, toks):
+            fkw = dict(kw, trace_id=trace_id)
+            rem = deadline.remaining()
+            if rem is not None:
+                fkw["deadline_s"] = rem
+            return self._generate_iter(list(prompt), steps, timeout, fkw,
+                                       already_delivered=delivered,
+                                       delivered_tokens=toks)
+
+        primary = launch(0, set())
+        if primary is None:
+            raise RuntimeError("no replicas")
+        live = [primary]
+        failed: set = set()
+        hedged = False
+        winner = first = None
+        try:
+            # -- the race: first token wins; one hedge at hedge_delay_s --
+            while winner is None:
+                wait = deadline.bound(
+                    None if hedged else self.hedge_delay_s)
+                try:
+                    kind, att, val = events.get(timeout=wait)
+                except _q.Empty:
+                    if deadline.expired():
+                        self._note_deadline(False, deadline)
+                        raise DeadlineExceeded(
+                            "generation deadline exceeded")
+                    if not hedged:
+                        hedged = True
+                        h = launch(1, {a.idx for a in live} | failed)
+                        if h is not None:
+                            self.hedges += 1
+                            m = self._metrics
+                            if m is not None and hasattr(m, "note_hedge"):
+                                m.note_hedge()
+                            live.append(h)
+                    continue
+                if kind == "tok":
+                    winner, first = att, val
+                elif kind == "cancelled":
+                    live.remove(att)
+                else:  # "err", or "end" with zero tokens (a dead stream)
+                    live.remove(att)
+                    failed.add(att.idx)
+                    exc = (val if kind == "err" else RuntimeError(
+                        "stream ended before the first token"))
+                    self._note_attempt(exc)
+                    self._attempt_span(att.t0, att.idx, att.no, trace_id,
+                                       exc, hedge=att.no)
+                    if isinstance(exc, DeadlineExceeded):
+                        self._note_deadline(False, deadline)
+                    if self._dispose_failure(att.idx, exc) == "raise":
+                        raise exc
+                    if not live:
+                        # both arms dead pre-first-token: hand the whole
+                        # request to the ordinary failover loop
+                        self._note_failover()
+                        yield from unified_fallback(0, [])
+                        return
+            # -- first-writer-wins: cancel the losers ---------------------
+            for a in live:
+                if a is not winner:
+                    a.cancel.set()
+            if winner.no > 0:
+                self.hedge_wins += 1
+                m = self._metrics
+                if m is not None and hasattr(m, "note_hedge"):
+                    m.note_hedge(won=True)
+            delivered = 1
+            toks = [int(first[0]) if pairs else int(first)]
+            yield first
+            # -- drain the winner -----------------------------------------
+            while True:
+                try:
+                    kind, att, val = events.get(
+                        timeout=deadline.bound(None))
+                except _q.Empty:
+                    self._note_deadline(False, deadline)
+                    raise DeadlineExceeded("generation deadline exceeded")
+                if att is not winner:
+                    continue  # late loser events: already cancelled
+                if kind == "tok":
+                    delivered += 1
+                    toks.append(int(val[0]) if pairs else int(val))
+                    yield val
+                    continue
+                if kind == "end":
+                    with self._lock:
+                        self.served[winner.idx] += 1
+                    self._record_success(winner.idx)
+                    self._note_served(winner.idx)
+                    self._note_attempt(None)
+                    self._attempt_span(winner.t0, winner.idx, winner.no,
+                                       trace_id, None, hedge=winner.no)
+                    self._note_deadline(True, deadline)
+                    return
+                exc = (val if kind == "err" else RuntimeError(
+                    "generation stream cancelled"))
+                self._note_attempt(exc)
+                self._attempt_span(winner.t0, winner.idx, winner.no,
+                                   trace_id, exc, hedge=winner.no)
+                if isinstance(exc, DeadlineExceeded):
+                    self._note_deadline(False, deadline)
+                if self._dispose_failure(winner.idx, exc) == "raise":
+                    raise exc
+                # the winner died mid-stream: ordinary failover (resume
+                # when the stream survives the hop) finishes the request
+                self._note_failover()
+                yield from unified_fallback(delivered, toks)
+                return
+        finally:
+            for a in live:
+                a.cancel.set()  # consumer gone / error: reap every arm
 
     # -- disaggregated routing (tpulab.disagg) -------------------------------
     def _known_roles(self) -> List[str]:
@@ -868,14 +1213,17 @@ class GenerationReplicaSet(_BaseReplicaSet):
         trace_id = kw.pop("trace_id", None) or mint_trace_id()
         stops = {int(t) for t in kw.get("stop_tokens", ())}
 
-        def fallback(delivered):
+        def fallback(delivered, toks=None):
             fkw = dict(kw, trace_id=trace_id)
             rem = deadline.remaining()
             if rem is not None:
                 fkw["deadline_s"] = rem
             self.disagg_fallbacks += 1
+            # delivered token VALUES ride along so the unified fallback
+            # can RESUME (one prefill) instead of full-replaying the hops
             return self._generate_iter(list(prompt), steps, timeout, fkw,
-                                       already_delivered=delivered)
+                                       already_delivered=delivered,
+                                       delivered_tokens=toks)
 
         roles = self._known_roles()
         prefills = {i for i, r in enumerate(roles) if r == "prefill"}
@@ -926,13 +1274,14 @@ class GenerationReplicaSet(_BaseReplicaSet):
             return
         yield first
         delivered = 1
+        toks = [int(first)]
         if steps <= 1 or int(first) in stops:
             self.disagg_handoffs += 1  # one-token request: prefill WAS it
             return
         # -- hop 2: shipped-KV decode ---------------------------------------
         didx = self._pick(frozenset(range(len(self._managers))) - decodes)
         if didx is None:
-            yield from fallback(delivered)
+            yield from fallback(delivered, toks)
             return
         gen = None
         t_att = time.perf_counter()
@@ -948,6 +1297,7 @@ class GenerationReplicaSet(_BaseReplicaSet):
             for item in gen:
                 if i >= delivered:  # index 0 was delivered from hop 1
                     delivered += 1
+                    toks.append(int(item))
                     yield item
                 i += 1
             with self._lock:
@@ -981,4 +1331,90 @@ class GenerationReplicaSet(_BaseReplicaSet):
                 self._note_inflight(didx)
             if gen is not None:
                 gen.close()
-        yield from fallback(delivered)
+        yield from fallback(delivered, toks)
+
+
+def benchmark_failover_recovery(prompt_len: int = 24, steps: int = 24,
+                                kill_at: int = 8) -> dict:
+    """bench.py ``failover_recovery`` row (docs/ROBUSTNESS.md "Stream
+    failover semantics"): two loopback replicas, a chaos mid-stream kill
+    (``rpc.stream=error``) at token ``kill_at``, resume-from-delivered ON
+    vs OFF.  Reported per mode: token parity with an uninterrupted run,
+    the recovery gap (largest inter-arrival gap at the consumer — the
+    dead air between the last pre-kill and first post-kill token), and
+    the replayed-token count.  On CPU jit the structural counts are the
+    signal (replayed tokens collapse to zero with resume ON; the
+    survivor pays one prefill); on-device the recovery-gap ratio is —
+    a full replay re-pays every delivered token's decode dispatch."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import tpulab
+    from tpulab import chaos
+    from tpulab.engine.paged import ContinuousBatcher
+    from tpulab.models.mnist import make_mnist
+    from tpulab.models.transformer import init_transformer_params
+
+    params = init_transformer_params(vocab=128, d_model=32, n_heads=2,
+                                     n_layers=2, d_ff=64)
+
+    def serve():
+        cb = ContinuousBatcher(params, n_heads=2, n_layers=2, lanes=2,
+                               max_len=max(64, prompt_len + steps + 8),
+                               page_size=8, compute_dtype=jnp.float32)
+        mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+        mgr.register_model("mnist", make_mnist(max_batch_size=1))
+        mgr.update_resources()
+        mgr.serve(port=0, generation_engines={"lm": cb})
+        return mgr, cb
+
+    (mgr_a, cb_a), (mgr_b, cb_b) = serve(), serve()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 128, (prompt_len,), np.int32)
+    out = {"prompt_len": prompt_len, "steps": steps, "kill_at": kill_at}
+    try:
+        for cb in (cb_a, cb_b):  # warm compiles: the gap must be failover,
+            #                      not jit.  A STREAMING consumer is part
+            #                      of the warm-up: it drops the adaptive
+            #                      block to K<=2, a different compiled
+            #                      scan than batch-style submits use
+            cb.submit(prompt, steps,
+                      on_token=lambda *a: None).result(timeout=300)
+            # the resume prompt (prompt + kill_at delivered tokens) can
+            # land in a bigger pow2 prefill bucket — warm it too, or the
+            # resume mode pays a one-off compile in its recovery gap
+            cb.submit(rng.integers(0, 128, (prompt_len + kill_at,),
+                                   np.int32), 2,
+                      on_token=lambda *a: None).result(timeout=300)
+        expected = [int(t) for t in
+                    cb_a.submit(prompt, steps).result(timeout=300)]
+        addrs = [f"127.0.0.1:{m.server.bound_port}" for m in (mgr_a, mgr_b)]
+        for mode, resume in (("resume_on", True), ("resume_off", False)):
+            rs = GenerationReplicaSet(addrs, "lm", resume_failover=resume,
+                                      inter_token_timeout_s=10.0)
+            try:
+                prefills0 = cb_a.prefill_dispatches + cb_b.prefill_dispatches
+                arrivals, got = [], []
+                with chaos.inject(f"rpc.stream=error@{kill_at}+1"):
+                    for tok in rs.generate(prompt, steps):
+                        arrivals.append(time.perf_counter())
+                        got.append(int(tok))
+                gaps = np.diff(np.asarray(arrivals))
+                out[mode] = {
+                    "parity": got == expected,
+                    "recovery_gap_ms": (round(float(gaps.max()) * 1e3, 2)
+                                        if gaps.size else 0.0),
+                    "tokens_replayed": rs.tokens_replayed,
+                    "resumes": rs.resumes,
+                    "failover_prefills": (cb_a.prefill_dispatches
+                                          + cb_b.prefill_dispatches
+                                          - prefills0),
+                }
+            finally:
+                rs.close()
+    finally:
+        for m in (mgr_a, mgr_b):
+            m.shutdown()
+        for cb in (cb_a, cb_b):
+            cb.shutdown()
+    return out

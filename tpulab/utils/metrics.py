@@ -221,6 +221,35 @@ class ReplicaSetMetrics:
             f"{ns}_deadline_slack_seconds",
             "Remaining budget at completion of deadline-bounded requests",
             buckets=SLACK_BUCKETS, registry=self.registry)
+        # -- durable streams (docs/ROBUSTNESS.md "Stream failover
+        # semantics"): how much failover work was wasted vs resumed ------
+        self.stalls = Counter(
+            f"{ns}_replica_stream_stalls_total",
+            "Streams failed over by the stall watchdog (no first token "
+            "within the TTFT bound / no progress within the inter-token "
+            "bound) — distinct from transport faults", registry=self.registry)
+        self.resumes = Counter(
+            f"{ns}_replica_stream_resumes_total",
+            "Failover attempts resubmitted as resume-from-delivered "
+            "(prompt+delivered re-prefilled, zero tokens replayed)",
+            registry=self.registry)
+        self.resume_fallbacks = Counter(
+            f"{ns}_replica_stream_resume_fallbacks_total",
+            "Resume attempts the server rejected, degraded to full replay",
+            registry=self.registry)
+        self.tokens_replayed = Counter(
+            f"{ns}_replica_tokens_replayed_total",
+            "Already-delivered tokens re-received and discarded on "
+            "full-replay failovers (the waste resume removes)",
+            registry=self.registry)
+        self.hedges = Counter(
+            f"{ns}_replica_hedges_total",
+            "Duplicate first-token attempts launched after the hedge delay",
+            registry=self.registry)
+        self.hedge_wins = Counter(
+            f"{ns}_replica_hedge_wins_total",
+            "Hedged requests whose duplicate attempt delivered the first "
+            "token (the primary lost the race)", registry=self.registry)
 
     # -- hooks (called by the replica sets; cold paths) ---------------------
     def set_breaker_state(self, replica: str, state: str) -> None:
@@ -243,6 +272,26 @@ class ReplicaSetMetrics:
             outcome="met" if met else "exceeded").inc()
         if met and slack_s is not None:
             self.deadline_slack.observe(max(0.0, slack_s))
+
+    # -- durable-stream hooks -------------------------------------------
+    def note_stall(self) -> None:
+        self.stalls.inc()
+
+    def note_resume(self) -> None:
+        self.resumes.inc()
+
+    def note_resume_fallback(self) -> None:
+        self.resume_fallbacks.inc()
+
+    def note_tokens_replayed(self, n: int = 1) -> None:
+        if n > 0:
+            self.tokens_replayed.inc(n)
+
+    def note_hedge(self, won: bool = False) -> None:
+        if won:
+            self.hedge_wins.inc()
+        else:
+            self.hedges.inc()
 
 
 class GenerationMetrics:
@@ -356,6 +405,17 @@ class GenerationMetrics:
             "Lifetime draft acceptance rate (accepted / drafted) — the "
             "multiplier on the decode-block dispatch amortization",
             registry=self.registry)
+        # -- durable streams: server-side resume admissions -----------------
+        self.resumed_streams = Counter(
+            f"{ns}_llm_resumed_streams",
+            "Generate streams admitted as resume-from-delivered "
+            "(prompt+delivered through one chunked prefill)",
+            registry=self.registry)
+        self.tokens_resume_skipped = Counter(
+            f"{ns}_llm_tokens_resume_skipped",
+            "Already-delivered tokens a resume admission did NOT re-decode "
+            "(each rode the prefill instead of a sequential decode step)",
+            registry=self.registry)
         self._ttft_res = _Reservoir()
         self._itl_res = _Reservoir()
         self._last: Dict[str, int] = {}
@@ -379,6 +439,13 @@ class GenerationMetrics:
 
     def note_deadline_expired(self) -> None:
         self.deadline_expired.inc()
+
+    def note_resume(self, tokens_skipped: int) -> None:
+        """One resume-from-delivered admission (Generate RPC): the
+        delivered prefix rode the prefill instead of re-decoding."""
+        self.resumed_streams.inc()
+        if tokens_skipped > 0:
+            self.tokens_resume_skipped.inc(tokens_skipped)
 
     def ttft_quantiles(self) -> Dict[str, float]:
         return {f"p{int(q * 100)}": self._ttft_res.quantile(q)
