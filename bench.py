@@ -842,6 +842,22 @@ def main() -> None:
     except Exception as e:
         print(f"# kv offload row skipped: {e!r}", file=sys.stderr)
 
+    # multi-model serving (docs/SERVING.md "Multi-model serving"): an
+    # interleaved two-model trace (transformer LLM + ViT classifier)
+    # under HBM weight pressure — the budget holds ONE model, so every
+    # switch swaps.  Multiplexer on (host-tier swap-ins) vs off (serial
+    # cold rebuild per switch).  The claims tracked: swap-in beats cold
+    # rebuild, evictions ride the write-behind path, and both modes emit
+    # bit-identical outputs (parity).
+    _phase("multi_model")
+    try:
+        from tpulab.modelstore import benchmark_multi_model
+        _record(multi_model=benchmark_multi_model(
+            switches=4 if degraded else 6,
+            steps=6 if degraded else 8))
+    except Exception as e:
+        print(f"# multi model row skipped: {e!r}", file=sys.stderr)
+
     # disaggregated prefill/decode (docs/SERVING.md "Replica roles"):
     # the same prefill-heavy trace served by one unified pool vs a
     # prefill replica shipping finished KV over the host tier's wire

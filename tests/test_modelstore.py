@@ -1,0 +1,605 @@
+"""Multi-model serving tests (tpulab.modelstore): host param-tier
+semantics, bit-exact weight swap roundtrips through the serving paths,
+working-set protection (leases/pinning/decode-active), chaos-degraded
+swaps falling back to cold rebuilds, the admission per-model dimension,
+registry additions, residency over the Status RPC, and metric labels."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpulab import chaos
+from tpulab.modelstore import (BatcherAdapter, CompiledModelAdapter,
+                               HostParamStore, WeightMultiplexer,
+                               tree_nbytes)
+
+
+def _simple_tree(seed: float, n: int = 1024):
+    return {"w": np.full((n,), float(seed), np.float32),
+            "q": {"w_int8": np.full((n,), int(seed) % 127, np.int8),
+                  "scale": np.ones((n,), np.float32)}}
+
+
+class SimpleServable:
+    """Minimal adapter-protocol servable (deterministic seeded rebuild)."""
+
+    def __init__(self, seed: float, n: int = 1024, resident: bool = True):
+        import jax
+        self.seed = seed
+        self.n = n
+        self.dev = jax.device_put(_simple_tree(seed, n)) if resident \
+            else None
+        self._busy = False
+
+    def resident(self):
+        return self.dev is not None
+
+    def param_bytes(self):
+        return tree_nbytes(self.dev if self.dev is not None
+                           else _simple_tree(self.seed, self.n))
+
+    def busy(self):
+        return self._busy
+
+    def detach(self):
+        dev, self.dev = self.dev, None
+        return dev
+
+    def on_detached(self):
+        pass
+
+    def attach(self, host_tree):
+        import jax
+        self.dev = jax.device_put(host_tree)
+
+    def rebuild(self):
+        return _simple_tree(self.seed, self.n)
+
+    def value(self):
+        return float(np.asarray(self.dev["w"])[0])
+
+
+# -- HostParamStore ----------------------------------------------------------
+
+def test_host_param_store_roundtrip_bit_exact():
+    store = HostParamStore(1 << 20)
+    tree = {"layer0": {"w": np.random.default_rng(0).standard_normal(
+                (8, 16)).astype(np.float32),
+            "q": {"w_int8": np.arange(-8, 8, dtype=np.int8),
+                  "scale": np.linspace(0.1, 1, 16).astype(np.float32)}},
+            "embed": np.arange(64, dtype=np.float32)}
+    assert store.put("m", tree)
+    got = store.get("m")
+    np.testing.assert_array_equal(got["layer0"]["w"], tree["layer0"]["w"])
+    np.testing.assert_array_equal(got["layer0"]["q"]["w_int8"],
+                                  tree["layer0"]["q"]["w_int8"])
+    assert got["layer0"]["q"]["w_int8"].dtype == np.int8
+    got["embed"][0] = 999.0                   # copy-on-get, never the view
+    assert store.get("m")["embed"][0] == 0.0
+    popped = store.pop("m")
+    np.testing.assert_array_equal(popped["embed"], tree["embed"])
+    assert "m" not in store and store.bytes_used == 0
+    assert store.get("m") is None and store.misses == 1
+
+
+def test_host_param_store_budget_lru_and_oversize():
+    tree = _simple_tree(1.0)                  # ~5 KiB
+    nbytes = tree_nbytes(tree)
+    store = HostParamStore(3 * nbytes)
+    for k in "abc":
+        assert store.put(k, tree)
+    store.get("a")                            # touch: "b" is now coldest
+    assert store.put("d", tree)
+    assert "b" not in store and store.evictions == 1
+    assert all(k in store for k in "acd")
+    assert not store.put("big", _simple_tree(1.0, 4 * 1024 * 1024))
+    assert store.drops == 1
+    assert store.keys()[0] == "c"             # coldest first
+    store.clear()
+    assert store.headroom_bytes == store.budget_bytes
+
+
+# -- multiplexer mechanics ---------------------------------------------------
+
+def test_swap_roundtrip_bit_exact_and_accounting():
+    a, b = SimpleServable(1), SimpleServable(2)
+    nb = a.param_bytes()
+    mux = WeightMultiplexer(nb + nb // 2)     # holds exactly one
+    mux.register("a", a)
+    mux.register("b", b)
+    assert mux.drain()
+    assert mux.resident_models() == ["b"] and mux.host_models() == ["a"]
+    with mux.acquire("a"):
+        assert a.value() == 1.0               # promoted bytes, bit-exact
+    assert mux.drain()
+    assert mux.swap_ins == 1 and mux.cold_rebuilds == 0
+    assert mux.hbm_bytes_in_use == nb         # only "a" accounted
+    dev = np.asarray(a.dev["q"]["w_int8"])
+    np.testing.assert_array_equal(dev, np.full((1024,), 1, np.int8))
+    mux.close()
+
+
+def test_lease_blocks_eviction_until_release():
+    a, b = SimpleServable(1), SimpleServable(2)
+    nb = a.param_bytes()
+    mux = WeightMultiplexer(nb + nb // 2)
+    mux.register("a", a)
+    mux.register("b", b)
+    mux.drain()
+    lease = mux.acquire("b")
+    with pytest.raises(TimeoutError):
+        mux.acquire("a", timeout=0.3)         # b leased: nothing evictable
+    assert b.dev is not None                  # working set untouched
+    assert not mux.can_admit("a")             # admission's queue signal
+    lease.release()
+    assert mux.can_admit("a")
+    with mux.acquire("a", timeout=30):
+        assert a.value() == 1.0
+    mux.close()
+
+
+def test_pinned_model_never_evicted():
+    a, b = SimpleServable(1), SimpleServable(2)
+    nb = a.param_bytes()
+    mux = WeightMultiplexer(nb + nb // 2)
+    mux.register("a", a, pinned=True)
+    mux.register("b", b, params=_simple_tree(2))
+    mux.drain()
+    assert mux.resident_models() == ["a"]     # pinned survived the trim
+    with pytest.raises(TimeoutError):
+        mux.acquire("b", timeout=0.3)
+    assert a.dev is not None
+    mux.pin("a", on=False)
+    with mux.acquire("b", timeout=30):
+        assert b.value() == 2.0
+    mux.close()
+
+
+def test_register_params_cold_and_lost_paths():
+    cold = SimpleServable(5, resident=False)
+    lost = SimpleServable(7, resident=False)
+    mux = WeightMultiplexer(1 << 20)
+    mux.register("cold", cold, params=_simple_tree(5))
+    mux.register("lost", lost)                # no params: first acquire
+    assert mux.state_of("cold") == "cold"     # rebuilds
+    assert mux.state_of("lost") == "lost"
+    with mux.acquire("cold"):
+        assert cold.value() == 5.0
+    with mux.acquire("lost"):
+        assert lost.value() == 7.0
+    assert mux.swap_ins == 1 and mux.cold_rebuilds == 1
+    mux.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("action", ["error", "drop"])
+def test_chaos_swap_out_degrades_to_cold_rebuild(action):
+    """A chaos-tripped swap-OUT loses the snapshot (HBM still frees) and
+    the next acquire serves a correct cold rebuild — never a corrupt
+    serve, and the request completes."""
+    a, b = SimpleServable(1), SimpleServable(2)
+    nb = a.param_bytes()
+    mux = WeightMultiplexer(nb + nb // 2)
+    mux.register("a", a)
+    mux.register("b", b)
+    mux.drain()
+    with chaos.inject(f"modelstore.swap={action}+1"):
+        with mux.acquire("a"):                # evicting b trips the rule
+            assert a.value() == 1.0
+    mux.drain()
+    assert mux.state_of("b") == "lost" and mux.swap_failures == 1
+    with mux.acquire("b"):                    # completes correctly anyway
+        assert b.value() == 2.0
+    assert mux.cold_rebuilds == 1
+    mux.close()
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("action", ["error", "drop"])
+def test_chaos_swap_in_degrades_to_cold_rebuild(action):
+    """A chaos-tripped swap-IN discards the host copy and serves a cold
+    rebuild in the same acquire — the request completes correctly."""
+    a, b = SimpleServable(1), SimpleServable(2)
+    nb = a.param_bytes()
+    mux = WeightMultiplexer(nb + nb // 2)
+    mux.register("a", a)
+    mux.register("b", b)
+    mux.drain()
+    with mux.acquire("a"):
+        pass                                  # a hot, b cold
+    mux.drain()
+    assert mux.state_of("b") == "cold"
+    # @1 skips the eviction's swap-out occurrence; the rule fires on the
+    # swap-in trip of b's acquire
+    with chaos.inject(f"modelstore.swap={action}@1+1"):
+        with mux.acquire("b", timeout=30):
+            assert b.value() == 2.0
+    assert mux.cold_rebuilds == 1 and mux.swap_failures == 1
+    assert "b" not in mux.store               # discarded, never re-served
+    mux.close()
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_new_names_and_unknown_error():
+    from tpulab.models.registry import available_models, build_model
+    names = available_models()
+    for expected in ("transformer_int8", "resnet50_int8", "onnx",
+                     "transformer", "vit_s16", "mnist"):
+        assert expected in names
+    with pytest.raises(KeyError, match="unknown model 'nope'"):
+        build_model("nope")
+    with pytest.raises(ValueError, match="requires path="):
+        build_model("onnx")
+    m8 = build_model("transformer_int8", vocab=64, d_model=32, n_heads=2,
+                     n_layers=1, d_ff=64, seq_len=8)
+    assert m8.name == "transformer_int8"
+    lp = m8.params["layer0"]["wqkv"]
+    assert lp["w_int8"].dtype == np.int8 and "scale" in lp
+    # the quantized variant serves through the same apply path
+    out = m8.apply_fn(m8.params,
+                      {"tokens": np.zeros((1, 8), np.int32)})
+    assert np.asarray(out["logits"]).shape == (1, 8, 64)
+
+
+# -- serving-path integration ------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mnist_mgr():
+    """An InferenceManager serving mnist under a modelstore sized to one
+    model — shared across the serving-path tests (compile once)."""
+    import tpulab
+    from tpulab.models.registry import build_model
+
+    mgr = tpulab.InferenceManager(max_exec_concurrency=2)
+    model = build_model("mnist")
+    nb = tree_nbytes(model.params)
+    mgr.register_model("mnist", model)
+    mgr.serve(port=0, models=["mnist"], model_hbm_budget=2 * nb)
+    yield mgr
+    mgr.shutdown()
+
+
+def _infer_mnist(res, x):
+    from tpulab.rpc.infer_service import InferContext, tensor_to_proto
+    from tpulab.rpc.protos import inference_pb2 as pb
+    req = pb.InferRequest(model_name="mnist", batch_size=1,
+                          inputs=[tensor_to_proto("Input3", x)])
+    resp = InferContext(res).execute_rpc(req)
+    assert resp.status.code == pb.SUCCESS, resp.status.message
+    return np.frombuffer(resp.outputs[0].raw_data, np.float32).copy()
+
+
+def test_compiled_model_swap_bit_exact_through_infer_rpc(mnist_mgr):
+    """The acceptance core on the dense path: serve, demote the weights
+    to the host tier, serve again — outputs bit-exact with the
+    single-model (pre-eviction) serving."""
+    res = mnist_mgr.server._infer_resources
+    ms = res.modelstore
+    x = np.random.default_rng(0).standard_normal(
+        (1, 28, 28, 1)).astype(np.float32)
+    ref = _infer_mnist(res, x)                # single-model behavior
+    swap_ins0, n0 = ms.swap_ins, ms.hbm_bytes_in_use
+    with ms._cv:
+        ms._swap_out_locked(ms._entries["mnist"])
+    assert ms.drain()
+    assert ms.state_of("mnist") == "cold" and "mnist" in ms.host_models()
+    assert ms.hbm_bytes_in_use == 0           # byte-accurate release
+    out = _infer_mnist(res, x)                # swap-in on the request path
+    np.testing.assert_array_equal(out, ref)
+    assert ms.swap_ins == swap_ins0 + 1
+    assert ms.hbm_bytes_in_use == n0
+
+
+def test_status_rpc_and_poll_load_surface_residency(mnist_mgr):
+    from tpulab.rpc.replica import ReplicaSet
+    addr = f"localhost:{mnist_mgr.server.bound_port}"
+    rs = ReplicaSet([addr], "mnist")
+    try:
+        load = rs.poll_load()
+        assert load[addr]["resident_models"] == ["mnist"]
+        assert load[addr]["host_models"] == []
+        assert rs._hot_hint[0] is True
+    finally:
+        for m in rs._managers:
+            m.close()
+
+
+def test_pick_prefers_replica_with_model_hot():
+    """Routing tie-break: among equally loaded replicas, the one that
+    last reported this model HBM-resident wins (no swap-in on path)."""
+    from tpulab.rpc.replica import ReplicaSet
+    rs = ReplicaSet(["h1:1", "h2:2", "h3:3"], "m")
+    try:
+        rs._hot_hint[1] = True                # only h2 has the model hot
+        picks = set()
+        for _ in range(6):
+            with rs._lock:
+                picks.add(rs._pick_locked(frozenset()))
+        assert picks == {1}
+        rs._hot_hint[1] = None                # neutral again: RR resumes
+        with rs._lock:
+            assert rs._pick_locked(frozenset()) is not None
+    finally:
+        for m in rs._managers:
+            m.close()
+
+
+# -- LLM + dense interleaving (the tentpole acceptance) ----------------------
+
+@pytest.fixture(scope="module")
+def llm_setup():
+    import jax.numpy as jnp
+    from tpulab.engine.paged import ContinuousBatcher
+    from tpulab.models.transformer import init_transformer_params
+
+    kw = dict(vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64)
+    cb = ContinuousBatcher(init_transformer_params(**kw), n_heads=2,
+                           n_layers=2, lanes=2, max_len=64,
+                           compute_dtype=jnp.float32)
+    yield cb, (lambda: init_transformer_params(**kw))
+    cb.shutdown()
+
+
+def test_two_models_over_budget_interleaved_bit_exact(llm_setup, mnist_mgr):
+    """Two models whose combined weights exceed the HBM budget serve
+    interleaved requests from one process with outputs bit-exact vs
+    single-model serving (the acceptance criterion)."""
+    cb, llm_builder = llm_setup
+    prompt = np.random.default_rng(1).integers(0, 64, (8,), np.int32)
+    steps = 6
+    ref_tokens = [int(t) for t in
+                  cb.submit(prompt, steps).result(timeout=120)]
+
+    res = mnist_mgr.server._infer_resources
+    x = np.random.default_rng(0).standard_normal(
+        (1, 28, 28, 1)).astype(np.float32)
+    ref_logits = _infer_mnist(res, x)
+
+    llm_bytes = tree_nbytes(cb.params)
+    mnist_bytes = tree_nbytes(mnist_mgr.compiled("mnist").device_params)
+    budget = (max(llm_bytes, mnist_bytes)
+              + min(llm_bytes, mnist_bytes) // 2)
+    assert llm_bytes + mnist_bytes > budget   # combined exceeds the budget
+
+    mux = WeightMultiplexer(budget)
+    mux.register("llm", BatcherAdapter(cb, llm_builder))
+    mux.register("mnist",
+                 CompiledModelAdapter(mnist_mgr.compiled("mnist")))
+    # point the service's lease path at THIS mux for the interleave
+    old_store = res.modelstore
+    res.modelstore = mux
+    try:
+        for i in range(6):
+            if i % 2 == 0:
+                with mux.acquire("llm", timeout=60):
+                    toks = [int(t) for t in
+                            cb.submit(prompt, steps).result(timeout=120)]
+                assert toks == ref_tokens     # bit-exact vs single-model
+            else:
+                out = _infer_mnist(res, x)    # lease + swap-in on path
+                np.testing.assert_array_equal(out, ref_logits)
+        assert mux.evictions >= 4             # every switch swapped
+        assert mux.swap_ins + mux.cold_rebuilds >= 4
+        assert mux.swap_failures == 0 and mux.cold_rebuilds == 0
+    finally:
+        res.modelstore = old_store
+        # leave mnist resident for other tests, managed by the old store
+        with mux.acquire("mnist", timeout=60):
+            pass
+        mux._entries.clear()                  # detach before close
+        mux.close()
+        if cb.params is None:                 # re-arm the shared batcher
+            BatcherAdapter(cb, llm_builder).attach(llm_builder())
+
+
+def test_decode_active_model_never_evicted_by_burst(llm_setup):
+    """A burst of acquires on model A while model B decodes in-flight
+    must wait — B's weights stay attached for its lanes' whole duration
+    and its stream completes (the acceptance criterion)."""
+    cb, llm_builder = llm_setup
+    other = SimpleServable(3, n=64 * 1024, resident=False)
+    if cb.params is None:                     # prior tests may have demoted
+        BatcherAdapter(cb, llm_builder).attach(llm_builder())
+    llm_bytes = tree_nbytes(cb.params)
+    # llm + other can never both be hot: admitting "other" would need
+    # llm's weights evicted
+    budget = (max(llm_bytes, other.param_bytes())
+              + min(llm_bytes, other.param_bytes()) // 2)
+    mux = WeightMultiplexer(budget)
+    mux.register("llm", BatcherAdapter(cb, llm_builder))
+    mux.register("other", other, params=_simple_tree(3, 64 * 1024))
+
+    prompt = np.random.default_rng(2).integers(0, 64, (8,), np.int32)
+    params_seen = []
+    lease = mux.acquire("llm")                # the RPC layer's stream lease
+    try:
+        fut = cb.submit(prompt, 24,
+                        on_token=lambda t, i:
+                        params_seen.append(cb.params is not None))
+        results = []
+
+        def burst():
+            try:
+                mux.acquire("other", timeout=0.5)
+                results.append("acquired")
+            except TimeoutError:
+                results.append("blocked")
+
+        threads = [threading.Thread(target=burst) for _ in range(3)]
+        for t in threads:
+            t.start()
+        toks = fut.result(timeout=120)
+        for t in threads:
+            t.join(timeout=10)
+        assert results == ["blocked"] * 3     # the burst waited, all of it
+        assert len(toks) == 24 and all(params_seen)
+    finally:
+        lease.release()
+    # with the stream done and the lease dropped, the burst model loads
+    with mux.acquire("other", timeout=60):
+        assert other.value() == 3.0
+    assert cb.params is None                  # llm demoted, not corrupted
+    with mux.acquire("llm", timeout=60):
+        toks2 = [int(t) for t in cb.submit(prompt, 24).result(timeout=120)]
+    ref = [int(t) for t in toks]
+    assert toks2 == ref                       # bit-exact after the cycle
+    mux.drain()
+    mux._entries.clear()                      # leave the shared cb intact
+    mux.close()
+
+
+def test_generate_rpc_leases_model_and_swaps_in(llm_setup):
+    """The Generate RPC path e2e: the stream leases its model's weights
+    (pinning them for the decode's duration), an eviction between
+    requests is restored by a swap-in on the next request, and tokens
+    stay bit-exact across the cycle."""
+    import tpulab
+    from tpulab.rpc.infer_service import (GenerateStreamClient,
+                                          RemoteInferenceManager)
+
+    cb, llm_builder = llm_setup
+    if cb.params is None:
+        BatcherAdapter(cb, llm_builder).attach(llm_builder())
+    other = SimpleServable(4, n=64 * 1024, resident=False)
+    llm_bytes = tree_nbytes(cb.params)
+    budget = (max(llm_bytes, other.param_bytes())
+              + min(llm_bytes, other.param_bytes()) // 2)
+    mux = WeightMultiplexer(budget)
+    mux.register("llm", BatcherAdapter(cb, llm_builder))
+    mux.register("other", other, params=_simple_tree(4, 64 * 1024))
+
+    mgr = tpulab.InferenceManager(max_exec_concurrency=1)
+    mgr.serve(port=0, generation_engines={"llm": cb}, modelstore=mux)
+    remote = RemoteInferenceManager(f"localhost:{mgr.server.bound_port}")
+    try:
+        prompt = np.random.default_rng(3).integers(0, 64, (8,), np.int32)
+        client = GenerateStreamClient(remote, "llm")
+        want = list(client.generate(prompt, 6, timeout=120))
+        assert len(want) == 6
+        with mux.acquire("other", timeout=60):  # evicts the idle llm
+            pass
+        mux.drain()
+        assert cb.params is None and mux.state_of("llm") == "cold"
+        si0 = mux.swap_ins
+        got = list(client.generate(prompt, 6, timeout=120))
+        assert got == want                    # bit-exact after the swap
+        assert mux.swap_ins == si0 + 1
+    finally:
+        remote.close()
+        mux._entries.clear()                  # the shared cb outlives mux
+        if cb.params is None:
+            BatcherAdapter(cb, llm_builder).attach(llm_builder())
+        mgr.shutdown()
+
+
+# -- admission: the per-model dimension --------------------------------------
+
+def test_admission_queues_burst_while_model_leased():
+    from tpulab.serving.admission import (AdmissionConfig,
+                                          AdmissionController,
+                                          AdmissionRejected)
+    a, b = SimpleServable(1), SimpleServable(2)
+    nb = a.param_bytes()
+    mux = WeightMultiplexer(nb + nb // 2)
+    mux.register("a", a)
+    mux.register("b", b)
+    mux.drain()
+    ctrl = AdmissionController(AdmissionConfig(admit_wait_s=0.3),
+                               modelstore=mux)
+    lease = mux.acquire("b")
+    with pytest.raises(AdmissionRejected) as ei:
+        ctrl.admit(cost=4, model="a")         # cannot evict leased b
+    assert ei.value.reason == "queue_timeout"
+    t_b = ctrl.admit(cost=4, model="b")       # the leased model admits
+    assert ctrl.model_inflight == {"b": 1}
+    t_b.release()
+    lease.release()
+    with ctrl.admit(cost=4, model="a"):       # now a is admittable
+        assert ctrl.model_inflight == {"a": 1}
+    assert ctrl.model_inflight == {}
+    mux.close()
+
+
+def test_admission_model_cost_and_priority_dimension():
+    from tpulab.serving.admission import (AdmissionConfig,
+                                          AdmissionController)
+    ctrl = AdmissionController(AdmissionConfig(
+        model_costs={"big": 4.0}, model_priorities={"vip": 7}))
+    t = ctrl.admit(cost=10, model="big")
+    assert t.cost == 40 and t.model == "big"  # per-model cost multiplier
+    t.release()
+    t2 = ctrl.admit(cost=10, model="small")
+    assert t2.cost == 10
+    t2.release()
+    # priority boost feeds the queue/shedding rank
+    tkt, w = ctrl._admit_or_enqueue("t", 1, 0, None, "vip")
+    assert tkt is not None                    # fast path; boost applied in
+    tkt.release()                             # admit() before enqueue
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_modelstore_metrics_poll_and_swap_histograms():
+    from tpulab.utils.metrics import ModelStoreMetrics
+    m = ModelStoreMetrics()
+    a, b = SimpleServable(1), SimpleServable(2)
+    nb = a.param_bytes()
+    mux = WeightMultiplexer(nb + nb // 2, metrics=m)
+    mux.register("a", a)
+    mux.register("b", b)
+    mux.drain()
+    with mux.acquire("a"):
+        pass
+    mux.drain()
+    m.poll(mux)
+
+    def val(name):
+        return m.registry.get_sample_value(name)
+
+    assert val("tpulab_modelstore_swap_ins_total") == 1
+    assert val("tpulab_modelstore_swap_outs_total") == 2
+    assert val("tpulab_modelstore_evictions_total") == 2
+    assert val("tpulab_modelstore_resident_models") == 1
+    assert val("tpulab_modelstore_host_tier_models") == 1
+    assert val("tpulab_modelstore_hbm_bytes") == nb
+    assert val("tpulab_modelstore_swap_in_seconds_count") == 1
+    assert val("tpulab_modelstore_swap_out_seconds_count") == 2
+    mux.close()
+
+
+def test_per_model_metric_labels():
+    from tpulab.utils.metrics import GenerationMetrics, InferenceMetrics
+    im = InferenceMetrics()
+    im.observe_request(0.01, 0.005, model="vit_s16")
+    im.observe_request(0.02, 0.01, model="vit_s16")
+    im.observe_request(0.02, 0.01)            # untagged: no model sample
+    assert im.registry.get_sample_value(
+        "tpulab_requests_by_model_total", {"model": "vit_s16"}) == 2
+    assert im.registry.get_sample_value(
+        "tpulab_request_duration_seconds_by_model_count",
+        {"model": "vit_s16"}) == 2
+
+    gm = GenerationMetrics(model="transformer")
+    gm.observe_ttft(0.02)
+    gm.observe_itl(0.003)
+
+    class FakeBatcher:
+        active_lanes = 1
+        queued_requests = 0
+        tokens_generated = 5
+        completed_requests = 1
+        preemptions = 0
+
+    gm.poll(FakeBatcher())
+    assert gm.registry.get_sample_value(
+        "tpulab_llm_ttft_seconds_by_model_count",
+        {"model": "transformer"}) == 1
+    assert gm.registry.get_sample_value(
+        "tpulab_llm_tokens_by_model_total",
+        {"model": "transformer"}) == 5
+    assert gm.registry.get_sample_value(
+        "tpulab_llm_requests_completed_by_model_total",
+        {"model": "transformer"}) == 1

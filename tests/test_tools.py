@@ -128,6 +128,15 @@ def test_gen_inference_pb2_schema_drift_and_roundtrip():
     rr = pb.GenerateRequest.FromString(pb.GenerateRequest(
         prompt=[1, 2, 9, 4], steps=8, resume_length=2).SerializeToString())
     assert rr.resume_length == 2
+
+    # multi-model serving (tpulab/modelstore): residency lists on Status —
+    # routers prefer a replica that already has the requested model hot
+    mm = pb.StatusResponse.FromString(pb.StatusResponse(
+        resident_models=["transformer", "vit_s16"],
+        host_models=["transformer_int8"]).SerializeToString())
+    assert list(mm.resident_models) == ["transformer", "vit_s16"]
+    assert list(mm.host_models) == ["transformer_int8"]
+    assert list(pb.StatusResponse().resident_models) == []  # no modelstore
     assert pb.GenerateRequest().resume_length == 0  # absent = fresh request
 
 

@@ -122,6 +122,12 @@ def build_file() -> dp.FileDescriptorProto:
         # routers (GenerationReplicaSet disaggregate=True) read it via
         # poll_load to learn which replicas prefill and which decode.
         field("role", 6, F.TYPE_STRING),
+        # multi-model serving (tpulab.modelstore): names currently
+        # HBM-resident vs parked in the host weight tier.  Routers
+        # prefer a replica that already has the requested model hot
+        # (no swap-in on the request path).
+        field("resident_models", 7, F.TYPE_STRING, REP),
+        field("host_models", 8, F.TYPE_STRING, REP),
     ])
 
     fd.message_type.add(name="HealthRequest")
@@ -258,6 +264,11 @@ def main() -> int:
         "sr = pb.StatusResponse.FromString(sr.SerializeToString());"
         "assert sr.queued_requests == 4 and sr.free_kv_pages == 99;"
         "assert sr.role == 'prefill';"
+        "mr = pb.StatusResponse(resident_models=['llm', 'vit_s16'],"
+        " host_models=['transformer_int8']);"
+        "mr = pb.StatusResponse.FromString(mr.SerializeToString());"
+        "assert list(mr.resident_models) == ['llm', 'vit_s16'];"
+        "assert list(mr.host_models) == ['transformer_int8'];"
         "dq = pb.GenerateRequest(prompt=[1], steps=2, prefill_only=True,"
         " kv_shipment=b'blob');"
         "dq = pb.GenerateRequest.FromString(dq.SerializeToString());"

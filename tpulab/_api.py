@@ -30,13 +30,18 @@ class InferenceManager(_EngineManager):
                          max_buffers=max_buffers, device=device,
                          coalesce_h2d=coalesce_h2d)
         self._server = None
+        self._modelstore = None
 
     def serve(self, port: int = 50051, wait: bool = False,
               executor=None, batching: bool = False,
               batch_window_s: float = 0.002,
               metrics=None, generation_engines=None,
               watchdog=None, trace=None,
-              admission=None, role: str = "unified") -> "InferenceManager":
+              admission=None, role: str = "unified",
+              models=None, modelstore=None,
+              model_hbm_budget: Optional[int] = None,
+              model_host_budget: Optional[int] = None,
+              pinned_models=()) -> "InferenceManager":
         """Expose registered models over the TRTIS-style gRPC service
         (reference manager.serve() -> BasicInferService).  ``batching=True``
         enables server-side dynamic batching across concurrent callers;
@@ -50,15 +55,53 @@ class InferenceManager(_EngineManager):
         declares the replica's disaggregated-serving role
         (docs/SERVING.md "Replica roles") — reported over the Status RPC
         so ``GenerationReplicaSet(disaggregate=True)`` routes prefills
-        and shipped-KV decodes to the right replicas."""
+        and shipped-KV decodes to the right replicas.
+
+        Multi-model serving (docs/SERVING.md "Multi-model serving"):
+        ``models=["transformer", "vit_s16", ...]`` builds and registers
+        those :mod:`tpulab.models.registry` names, and with
+        ``model_hbm_budget`` (bytes) arms a
+        :class:`tpulab.modelstore.WeightMultiplexer` over them — cold
+        weights park in the budgeted host tier (``model_host_budget``)
+        and requests swap their model hot on demand; ``pinned_models``
+        stay permanently resident.  Pass an existing ``modelstore`` to
+        share one multiplexer with generation engines registered via
+        :class:`tpulab.modelstore.BatcherAdapter`."""
+        builders = {}
+        if models:
+            from tpulab.models.registry import build_model
+            for name in models:
+                builders[name] = (lambda n=name: build_model(n))
+                if name not in self._models:
+                    self.register_model(name, build_model(name))
         if not self._allocated:
             # generation-only serving needs no dense models
             self.update_resources(allow_empty=bool(generation_engines))
+        if modelstore is None and models and model_hbm_budget:
+            from tpulab.modelstore import WeightMultiplexer
+            kw = {}
+            if model_host_budget:
+                kw["host_budget_bytes"] = int(model_host_budget)
+            # share the manager's write-behind TransferEngine: weight
+            # swap-outs ride the same collector the KV tier uses
+            modelstore = WeightMultiplexer(int(model_hbm_budget),
+                                           transfer=self._transfer_engine,
+                                           **kw)
+        if modelstore is not None and models:
+            from tpulab.modelstore import CompiledModelAdapter
+            for name in models:
+                if name not in modelstore:
+                    modelstore.register(
+                        name,
+                        CompiledModelAdapter(self.compiled(name),
+                                             builders.get(name)),
+                        pinned=name in (pinned_models or ()))
+        self._modelstore = modelstore
         self._server = build_infer_service(
             self, f"0.0.0.0:{port}", executor=executor, batching=batching,
             batch_window_s=batch_window_s, metrics=metrics, trace=trace,
             generation_engines=generation_engines, watchdog=watchdog,
-            admission=admission, role=role)
+            admission=admission, role=role, modelstore=modelstore)
         if wait:
             self._server.run()
         else:
@@ -69,6 +112,12 @@ class InferenceManager(_EngineManager):
     @property
     def server(self):
         return self._server
+
+    @property
+    def modelstore(self):
+        """The armed :class:`tpulab.modelstore.WeightMultiplexer` (None =
+        single-model serving)."""
+        return self._modelstore
 
     def drain(self, timeout: float = 30.0, poll_s: float = 0.05,
               settle_s: float = 10.0) -> bool:
@@ -101,6 +150,11 @@ class InferenceManager(_EngineManager):
         if self._server is not None:
             self._server.shutdown()  # owns the attached service resources
             self._server = None
+        if self._modelstore is not None:
+            # before super(): swap-out drains need the (shared) transfer
+            # engine alive
+            self._modelstore.close()
+            self._modelstore = None
         super().shutdown()
 
 

@@ -75,6 +75,13 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
                               error/drop lose that KV shipment: the decode
                               replica degrades to a local prefill, never a
                               corrupt lane or a stuck request
+    modelstore.swap           WeightMultiplexer swap-out/swap-in
+                              (tpulab.modelstore) — error/drop at swap-out
+                              lose that model's weight snapshot (HBM still
+                              frees; the next acquire cold-rebuilds), at
+                              swap-in discard the host copy and serve a
+                              cold rebuild instead: degraded weights are
+                              always REBUILT weights, never a corrupt serve
 """
 
 from __future__ import annotations

@@ -30,12 +30,54 @@ def _vit(variant: str, patch: int):
     return build
 
 
+def _transformer_int8(**kw):
+    """Weight-only INT8 transformer (models/quantization.py): the same
+    seeded build with every projection stored {w_int8, scale} — the
+    forwards dequantize transparently via ``qmat``, so the variant serves
+    through the same engines (and multiplexes next to float models with
+    heterogeneous dtypes in the host tier)."""
+    from tpulab.engine.model import Model
+    from tpulab.models.quantization import quantize_transformer_params
+    from tpulab.models.transformer import make_transformer
+    m = make_transformer(**kw)
+    return Model("transformer_int8", m.apply_fn,
+                 quantize_transformer_params(m.params), m.inputs,
+                 m.outputs, m.max_batch_size, m.batch_buckets)
+
+
+def _resnet_int8(depth: int):
+    def build(**kw):
+        from tpulab.engine.model import Model
+        from tpulab.models.quantization import quantize_resnet_params
+        from tpulab.models.resnet import make_resnet
+        m = make_resnet(depth=depth, **kw)
+        return Model(f"resnet{depth}_int8", m.apply_fn,
+                     quantize_resnet_params(m.params), m.inputs,
+                     m.outputs, m.max_batch_size, m.batch_buckets)
+    return build
+
+
+def _onnx(path: str = "", **kw):
+    """ONNX import entry point: ``build_model("onnx", path="model.onnx",
+    name=..., weight_quant="int8")`` — the registry face of
+    :func:`tpulab.models.onnx_import.load_onnx_model`."""
+    if not path:
+        raise ValueError(
+            "registry entry 'onnx' requires path=<model.onnx> "
+            "(e.g. build_model('onnx', path='resnet50.onnx'))")
+    from tpulab.models.onnx_import import load_onnx_model
+    return load_onnx_model(path, **kw)
+
+
 _REGISTRY: Dict[str, Callable] = {
     "resnet50": _resnet(50),
     "resnet101": _resnet(101),
     "resnet152": _resnet(152),
+    "resnet50_int8": _resnet_int8(50),
     "mnist": _mnist,
     "transformer": _transformer,
+    "transformer_int8": _transformer_int8,
+    "onnx": _onnx,
     "vit_s16": _vit("s", 16),
     "vit_b16": _vit("b", 16),
     "vit_l16": _vit("l", 16),

@@ -1,0 +1,202 @@
+"""Host-memory parameter tier: budgeted, LRU store for weight pytrees.
+
+The multi-model serving mode (:mod:`tpulab.modelstore`) keeps only the
+*hot* models' weights in HBM; every other registered model's parameters
+live here — host RAM, budgeted, LRU — exactly the tier
+:class:`~tpulab.kvcache.host_store.HostKVStore` provides for KV pages,
+generalized from one opaque array per key to a whole parameter pytree
+(transformer layer dicts, quantized ``{"w_int8", "scale"}`` leaves, ONNX
+import trees — any structure ``jax.tree_util`` can flatten).
+
+Storage mirrors ``HostKVStore`` deliberately: every leaf owns a
+:class:`~tpulab.memory.descriptor.Descriptor` from a host ``IAllocator``
+(default: the mmap-backed
+:class:`~tpulab.memory.raw_allocators.MallocAllocator` behind the
+``make_allocator`` facade) and is written through the descriptor's
+zero-copy numpy view; ``get``/``pop`` return *copies* assembled back into
+the original treedef — an LRU eviction from another thread closes the
+backing mapping, and a zero-copy view must not outlive it
+(copy-on-get).  All *policy* (which model to demote, when to promote)
+lives in :class:`~tpulab.modelstore.multiplexer.WeightMultiplexer`.
+
+Thread safety: one lock — the TransferEngine collector thread lands
+swap-outs here while acquire paths read/pop.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from tpulab.memory.allocator import make_allocator
+from tpulab.memory.raw_allocators import MallocAllocator
+
+#: default host-tier budget for cold weights (bytes)
+DEFAULT_HOST_BUDGET = 1 << 30
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total leaf bytes of a parameter pytree (counting quantized leaves
+    at their stored width)."""
+    import jax
+    return sum(np.dtype(leaf.dtype).itemsize * int(np.prod(leaf.shape))
+               for leaf in jax.tree_util.tree_leaves(tree)
+               if hasattr(leaf, "shape"))
+
+
+class _Leaf:
+    __slots__ = ("desc", "shape", "dtype")
+
+    def __init__(self, desc, shape: Tuple[int, ...], dtype):
+        self.desc = desc
+        self.shape = shape
+        self.dtype = dtype
+
+
+class _Entry:
+    __slots__ = ("leaves", "treedef", "nbytes")
+
+    def __init__(self, leaves: List[_Leaf], treedef, nbytes: int):
+        self.leaves = leaves
+        self.treedef = treedef
+        self.nbytes = nbytes
+
+    def release(self) -> None:
+        for leaf in self.leaves:
+            leaf.desc.release()
+
+
+class HostParamStore:
+    """Budgeted LRU store for model parameter pytrees (module docstring).
+
+    ``budget_bytes`` caps resident parameter bytes; inserting past it
+    evicts cold models first, and a single model larger than the whole
+    budget is refused (``put`` returns False — the caller's lost-weights
+    path: the next swap-in does a cold rebuild instead).
+    """
+
+    def __init__(self, budget_bytes: int = DEFAULT_HOST_BUDGET,
+                 allocator=None):
+        if budget_bytes <= 0:
+            raise ValueError("budget_bytes must be > 0")
+        self.budget_bytes = int(budget_bytes)
+        self._alloc = allocator or make_allocator(MallocAllocator())
+        self._entries: "OrderedDict[Any, _Entry]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        # -- counters (poll-advanced by ModelStoreMetrics) ------------------
+        self.puts = 0          # param trees stored
+        self.hits = 0          # get/pop found the key
+        self.misses = 0        # get/pop did not
+        self.evictions = 0     # LRU models pushed out by budget pressure
+        self.drops = 0         # param trees refused (larger than budget)
+
+    # -- sizing --------------------------------------------------------------
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    @property
+    def headroom_bytes(self) -> int:
+        """Bytes storable right now WITHOUT evicting."""
+        with self._lock:
+            return max(0, self.budget_bytes - self._bytes)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def keys(self) -> List[Any]:
+        """Resident keys, coldest first (the Status RPC's host-tier
+        model list)."""
+        with self._lock:
+            return list(self._entries)
+
+    # -- the tier ------------------------------------------------------------
+    def put(self, key, tree: Any) -> bool:
+        """Store the parameter pytree under ``key`` (replacing any
+        incumbent), evicting LRU entries until it fits.  False = refused
+        (the tree exceeds the whole budget) — the model is simply NOT in
+        the tier and its next swap-in cold-rebuilds."""
+        import jax
+        raw, treedef = jax.tree_util.tree_flatten(tree)
+        arrays = [np.ascontiguousarray(np.asarray(x)) for x in raw]
+        nbytes = sum(int(a.nbytes) for a in arrays)
+        with self._lock:
+            if nbytes > self.budget_bytes:
+                self.drops += 1
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+                old.release()
+            while self._bytes + nbytes > self.budget_bytes and self._entries:
+                _, cold = self._entries.popitem(last=False)
+                self._bytes -= cold.nbytes
+                cold.release()
+                self.evictions += 1
+            leaves = []
+            for a in arrays:
+                desc = self._alloc.allocate_descriptor(max(1, int(a.nbytes)))
+                desc.numpy(a.dtype, a.shape)[...] = a
+                leaves.append(_Leaf(desc, a.shape, a.dtype))
+            self._entries[key] = _Entry(leaves, treedef, nbytes)
+            self._bytes += nbytes
+            self.puts += 1
+            return True
+
+    def _assemble(self, e: _Entry) -> Any:
+        import jax
+        arrays = [leaf.desc.numpy(leaf.dtype, leaf.shape).copy()
+                  for leaf in e.leaves]
+        return jax.tree_util.tree_unflatten(e.treedef, arrays)
+
+    def get(self, key) -> Optional[Any]:
+        """A COPY of the param tree (and an LRU touch), or None."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return self._assemble(e)
+
+    def pop(self, key) -> Optional[Any]:
+        """``get`` + remove — the swap-in read (a model is in exactly one
+        tier at a time: promoting it to HBM removes the host copy; the
+        eviction path writes it back)."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is None:
+                self.misses += 1
+                return None
+            self._bytes -= e.nbytes
+            self.hits += 1
+            tree = self._assemble(e)
+            e.release()
+            return tree
+
+    def remove(self, key) -> bool:
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is None:
+                return False
+            self._bytes -= e.nbytes
+            e.release()
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            for e in self._entries.values():
+                e.release()
+            self._entries.clear()
+            self._bytes = 0

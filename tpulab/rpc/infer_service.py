@@ -64,9 +64,16 @@ class InferResources(Resources):
                  batch_window_s: float = 0.002, metrics=None,
                  generation_engines: Optional[Dict[str, object]] = None,
                  watchdog=None, trace=None, admission=None,
-                 role: str = "unified"):
+                 role: str = "unified", modelstore=None):
         self.manager = manager
         self.metrics = metrics
+        #: optional tpulab.modelstore.WeightMultiplexer — multi-model
+        #: serving (docs/SERVING.md "Multi-model serving"): requests for
+        #: a managed model acquire a lease (swap the weights in if cold,
+        #: pin them hot for the request's duration); Status reports
+        #: resident vs host-tier models.  None = single-model serving,
+        #: one is-None branch per request.
+        self.modelstore = modelstore
         #: disaggregated serving role ("prefill" | "decode" | "unified",
         #: docs/SERVING.md "Replica roles") — reported over the Status
         #: RPC so role-aware routers can see it.  Advisory: the router
@@ -198,6 +205,14 @@ class StatusContext(Context):
         resp.queued_requests = queued
         resp.free_kv_pages = free_pages
         resp.role = res.role
+        if res.modelstore is not None:
+            # multi-model residency report: routers prefer a replica that
+            # already has the requested model hot (no swap-in on path)
+            try:
+                resp.resident_models.extend(res.modelstore.resident_models())
+                resp.host_models.extend(res.modelstore.host_models())
+            except Exception:  # torn-down store: report what we can
+                pass
         names = ([request.model_name] if request.model_name
                  else mgr.model_names)
         for name in names:
@@ -293,11 +308,28 @@ class InferContext(Context):
                 ticket = res.admission.admit(
                     tenant=tenant_of_request(request, self.grpc_context),
                     cost=max(1, request.batch_size), deadline=deadline,
-                    trace_id=tc0.trace_id if tc0 is not None else None)
+                    trace_id=tc0.trace_id if tc0 is not None else None,
+                    model=request.model_name)
             except AdmissionRejected as e:
                 resp.status.code = pb.RESOURCE_EXHAUSTED
                 resp.status.message = str(e)
                 resp.status.retry_after_ms = e.retry_after_ms
+                return resp
+        lease = None
+        if (res.modelstore is not None
+                and request.model_name in res.modelstore):
+            # multi-model serving: pin the weights hot for the request's
+            # duration (swapping them in from the host tier / a cold
+            # rebuild first if needed).  Unacquirable = the hot set is
+            # fully leased elsewhere: that is overload, not a fault.
+            try:
+                lease = res.modelstore.acquire(request.model_name)
+            except TimeoutError as e:
+                if ticket is not None:
+                    ticket.release()
+                resp.status.code = pb.RESOURCE_EXHAUSTED
+                resp.status.message = (
+                    f"model weights not acquirable: {e}")
                 return resp
         try:
             import time as _time
@@ -318,7 +350,8 @@ class InferContext(Context):
             t2 = _time.perf_counter()
             resp.status.code = pb.SUCCESS
             if res.metrics is not None:
-                res.metrics.observe_request(self.walltime(), compute_s)
+                res.metrics.observe_request(self.walltime(), compute_s,
+                                            model=request.model_name)
             # stage accounting: window+queue from the batched runner when
             # present; pipeline = everything between enqueue-return and
             # result minus the aggregation wait
@@ -349,6 +382,8 @@ class InferContext(Context):
             resp.status.code = pb.INTERNAL
             resp.status.message = str(e)
         finally:
+            if lease is not None:
+                lease.release()
             if ticket is not None:
                 ticket.release()
         return resp
@@ -469,7 +504,7 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                         metrics=None,
                         generation_engines: Optional[Dict[str, object]] = None,
                         watchdog=None, trace=None, admission=None,
-                        role: str = "unified") -> Server:
+                        role: str = "unified", modelstore=None) -> Server:
     """Wire the inference service onto a Server
     (reference BasicInferService ctor infer.cc:644-678).
 
@@ -482,18 +517,28 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     ``RESOURCE_EXHAUSTED`` + ``retry_after_ms``.  ``role`` declares the
     replica's disaggregated-serving role (``"prefill"`` / ``"decode"`` /
     ``"unified"``, docs/SERVING.md "Replica roles"), reported over the
-    Status RPC for role-aware routers."""
+    Status RPC for role-aware routers.  ``modelstore`` is an optional
+    :class:`tpulab.modelstore.WeightMultiplexer`: multi-model serving —
+    requests for a managed model lease its weights (swapped in from the
+    host tier if cold, pinned hot for the request's duration) and Status
+    reports resident vs host-tier models (docs/SERVING.md "Multi-model
+    serving")."""
     if admission is not None and trace is not None \
             and getattr(admission, "trace", None) is None:
         # adopt the service's recorder: admission-decision spans land on
         # the same timeline as the request lifecycle spans
         admission.trace = trace
+    if admission is not None and modelstore is not None \
+            and getattr(admission, "modelstore", None) is None:
+        # adopt the store: admission's per-model capacity gate queues a
+        # burst on model A instead of letting it thrash model B's hot set
+        admission.modelstore = modelstore
     resources = InferResources(manager, batching=batching,
                                batch_window_s=batch_window_s, metrics=metrics,
                                trace=trace,
                                generation_engines=generation_engines,
                                watchdog=watchdog, admission=admission,
-                               role=role)
+                               role=role, modelstore=modelstore)
     server = Server(address, executor or Executor(n_threads=4))
     server._infer_resources = resources  # for shutdown
     service = AsyncService(SERVICE_NAME, resources)
@@ -609,9 +654,27 @@ class GenerateContext(StreamingContext):
             ok, ticket = self._admit(request, res, deadline)
             if not ok:
                 return
+        lease = None
+        if (res.modelstore is not None
+                and request.model_name in res.modelstore):
+            # multi-model serving: the lease pins this model's weights
+            # hot for the WHOLE stream — a decode-in-flight model can
+            # never be evicted by a burst on another model
+            try:
+                lease = res.modelstore.acquire(request.model_name)
+            except TimeoutError as e:
+                self.write(pb.GenerateResponse(
+                    final=True, status=pb.RequestStatus(
+                        code=pb.RESOURCE_EXHAUSTED,
+                        message=f"model weights not acquirable: {e}")))
+                if ticket is not None:
+                    ticket.release()
+                return
         try:
             self._run_engine(engine, request, deadline)
         finally:
+            if lease is not None:
+                lease.release()
             if ticket is not None:
                 ticket.release()
 
@@ -697,7 +760,8 @@ class GenerateContext(StreamingContext):
                 tenant=tenant_of_request(request, self.grpc_context),
                 cost=cost,
                 priority=request.priority, deadline=deadline,
-                trace_id=tc.trace_id if tc is not None else None)
+                trace_id=tc.trace_id if tc is not None else None,
+                model=request.model_name)
         except AdmissionRejected as e:
             st = pb.RequestStatus(code=pb.RESOURCE_EXHAUSTED,
                                   message=str(e),

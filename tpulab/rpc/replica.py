@@ -132,6 +132,11 @@ class _BaseReplicaSet:
         #: ("prefill"/"decode"/"unified"/"" unknown; Status RPC via
         #: poll_load()) — role-aware routing reads these
         self._role_hint = [""] * len(self._managers)
+        #: whether each replica last reported this set's model HBM-
+        #: resident (multi-model serving, StatusResponse.resident_models
+        #: via poll_load()); None = the replica never reported residency
+        #: (no modelstore) and the preference stays neutral
+        self._hot_hint: List[Optional[bool]] = [None] * len(self._managers)
         self._max_failover = (len(self._managers) if max_failover is None
                               else max_failover)
         # -- circuit breaker (0/None disables) ------------------------------
@@ -409,12 +414,22 @@ class _BaseReplicaSet:
             try:
                 resp = fut.result(timeout=timeout)
                 role = str(getattr(resp, "role", "") or "")
+                resident = [str(m) for m in
+                            getattr(resp, "resident_models", ())]
+                host = [str(m) for m in getattr(resp, "host_models", ())]
                 out[addr] = {"queued_requests": int(resp.queued_requests),
                              "free_kv_pages": int(resp.free_kv_pages),
-                             "role": role}
+                             "role": role,
+                             "resident_models": resident,
+                             "host_models": host}
                 with self._lock:
                     self._load_hint[i] = int(resp.queued_requests)
                     self._role_hint[i] = role
+                    # multi-model residency: only meaningful when the
+                    # replica runs a modelstore (it reports SOME list);
+                    # single-model replicas stay neutral (None)
+                    self._hot_hint[i] = (self.model_name in resident
+                                         if (resident or host) else None)
             except Exception as e:  # noqa: BLE001 - dead replica is data
                 out[addr] = {"error": f"{type(e).__name__}: {e}"}
         return out
@@ -452,7 +467,17 @@ class _BaseReplicaSet:
         lo = min(n for n, _ in candidates)
         tied = [i for n, i in candidates if n == lo]
         if len(tied) > 1:
-            # inflight tie: prefer the replica whose LAST REPORTED load
+            # inflight tie: prefer a replica that already has this set's
+            # model HBM-resident (multi-model serving, poll_load's
+            # residency hint) — routing to a cold replica pays a weight
+            # swap-in on the request path.  Only narrows when SOME tied
+            # replica is known-hot; with none (all cold or never
+            # reported) the tie passes through untouched.
+            hot = [i for i in tied if self._hot_hint[i] is True]
+            if hot and len(hot) < len(tied):
+                tied = hot
+        if len(tied) > 1:
+            # then prefer the replica whose LAST REPORTED load
             # (Status RPC queued_requests, poll_load()) is lowest — local
             # inflight is this client's view only; the hint folds in what
             # every other client is doing.  RR still rotates full ties.

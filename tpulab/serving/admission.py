@@ -122,6 +122,15 @@ class AdmissionConfig:
     #: distinct per-tenant buckets kept before the stalest is evicted
     #: (an unauthenticated tenant header must not be a memory leak)
     tenant_bucket_cap: int = 4096
+    #: per-model admission-cost multipliers (multi-model serving,
+    #: docs/SERVING.md "Multi-model serving"): a heavyweight model's
+    #: requests charge more of the shared capacity than a tiny one's.
+    #: Models not listed cost 1.0x.
+    model_costs: Optional[Dict[str, float]] = None
+    #: per-model base-priority boosts added to each request's own
+    #: priority (shedding order + queue ranking): a latency-critical
+    #: model's traffic outranks a batch model's at overflow
+    model_priorities: Optional[Dict[str, int]] = None
 
 
 class TokenBucket:
@@ -162,13 +171,14 @@ class AdmissionTicket:
     """One admitted request's capacity hold; ``release()`` (or context
     exit) returns it and dispatches the next queued admission."""
 
-    __slots__ = ("tenant", "cost", "queue_wait_s", "_ctrl", "_t_admit",
-                 "_released")
+    __slots__ = ("tenant", "cost", "model", "queue_wait_s", "_ctrl",
+                 "_t_admit", "_released")
 
     def __init__(self, ctrl: "AdmissionController", tenant: str, cost: int,
-                 queue_wait_s: float):
+                 queue_wait_s: float, model: str = ""):
         self.tenant = tenant
         self.cost = cost
+        self.model = model
         self.queue_wait_s = queue_wait_s
         self._ctrl = ctrl
         self._t_admit = time.perf_counter()
@@ -189,13 +199,14 @@ class AdmissionTicket:
 class _Waiter:
     """A queued admission request (entry in the DRR queue)."""
 
-    __slots__ = ("tenant", "cost", "priority", "deadline", "seq", "event",
-                 "ticket", "reject", "t_enqueue")
+    __slots__ = ("tenant", "cost", "model", "priority", "deadline", "seq",
+                 "event", "ticket", "reject", "t_enqueue")
 
     def __init__(self, tenant: str, cost: int, priority: int,
-                 deadline: Optional[Deadline], seq: int):
+                 deadline: Optional[Deadline], seq: int, model: str = ""):
         self.tenant = tenant
         self.cost = cost
+        self.model = model
         self.priority = priority
         self.deadline = deadline
         self.seq = seq
@@ -218,11 +229,17 @@ class AdmissionController:
     """
 
     def __init__(self, config: Optional[AdmissionConfig] = None,
-                 load=None, metrics=None, trace=None):
+                 load=None, metrics=None, trace=None, modelstore=None):
         self.config = config or AdmissionConfig()
         self._load = load
         self._metrics = metrics
         self.trace = trace
+        #: optional tpulab.modelstore.WeightMultiplexer — the per-model
+        #: capacity gate: a request for a model that cannot be made
+        #: HBM-resident without evicting a leased/pinned/decode-active
+        #: model QUEUES (never thrashes the hot working set); adopted by
+        #: build_infer_service when a modelstore is served
+        self.modelstore = modelstore
         cfg = self.config
         self._lock = threading.Lock()
         self._queue = DeficitRoundRobinQueue(quantum=cfg.drr_quantum)
@@ -239,6 +256,9 @@ class AdmissionController:
         self.shed_total = 0
         self.rejected_by_reason: Dict[str, int] = {}
         self.peak_queue_depth = 0
+        #: live admitted requests per model name (the multi-model load
+        #: view; "" aggregates requests that carried no model)
+        self.model_inflight: Dict[str, int] = {}
 
     # -- load signals --------------------------------------------------------
     @property
@@ -251,7 +271,7 @@ class AdmissionController:
         with self._lock:
             return self._inflight
 
-    def _capacity_ok_locked(self, cost: int) -> bool:
+    def _capacity_ok_locked(self, cost: int, model: str = "") -> bool:
         """Cost-aware dispatch gate: the load source must have the free KV
         pages to hold ``cost`` tokens and lane headroom to schedule the
         request soon (at most one lane-set's worth queued inside the
@@ -262,6 +282,17 @@ class AdmissionController:
         over the model axis, so counting LOGICAL free pages is already
         the per-shard headroom — one free page is page_nbytes/M bytes
         free on every shard at once."""
+        ms = self.modelstore
+        if ms is not None and model:
+            try:
+                if not ms.can_admit(model):
+                    # multi-model serving: this model's weights cannot be
+                    # made resident without evicting a leased/pinned/
+                    # decode-active model — a burst on model A queues here
+                    # instead of thrashing model B's working set mid-decode
+                    return False
+            except Exception:  # a torn-down store must not wedge admission
+                pass
         eng = self._load
         if eng is None:
             return True
@@ -317,15 +348,26 @@ class AdmissionController:
     # -- the decision --------------------------------------------------------
     def admit(self, tenant: str = "", cost: int = 1, priority: int = 0,
               deadline: Optional[Deadline] = None,
-              trace_id: Optional[str] = None) -> AdmissionTicket:
+              trace_id: Optional[str] = None,
+              model: str = "") -> AdmissionTicket:
         """Admit (possibly after a bounded fair-queue wait) or raise
         :class:`AdmissionRejected`.  ``cost`` is estimated tokens
         (prompt + steps) for generation, batch size for dense inference.
-        The returned ticket MUST be released when the request finishes
-        (context manager)."""
+        ``model`` arms the per-model dimension (multi-model serving):
+        the configured per-model cost multiplier and priority boost
+        apply, the modelstore residency gate is consulted, and the
+        request counts in :attr:`model_inflight`.  The returned ticket
+        MUST be released when the request finishes (context manager)."""
         t0 = time.perf_counter()
         tenant = tenant or DEFAULT_TENANT
         cost = max(1, int(cost))
+        cfg = self.config
+        if model:
+            if cfg.model_costs:
+                cost = max(1, int(cost * float(
+                    cfg.model_costs.get(model, 1.0))))
+            if cfg.model_priorities:
+                priority += int(cfg.model_priorities.get(model, 0))
         try:
             # chaos: force the overload path on demand (error/drop -> a
             # synthetic rejection; delay -> a slow admission decision)
@@ -337,7 +379,7 @@ class AdmissionController:
                     "chaos", f"admission chaos: {e}",
                     retry_after_ms=self.config.min_retry_after_ms)
             ticket, waiter = self._admit_or_enqueue(tenant, cost, priority,
-                                                    deadline)
+                                                    deadline, model)
             if ticket is None:  # queued: wait for dispatch/shed/expiry
                 ticket = self._wait(waiter, deadline)
         except AdmissionRejected as e:
@@ -347,7 +389,7 @@ class AdmissionController:
         return ticket
 
     def _admit_or_enqueue(self, tenant: str, cost: int, priority: int,
-                          deadline: Optional[Deadline]):
+                          deadline: Optional[Deadline], model: str = ""):
         cfg = self.config
         with self._lock:
             # 1) rate limits fail fast — a bucket that says "not now" must
@@ -375,10 +417,12 @@ class AdmissionController:
                                            int(tb.retry_after_s() * 1e3)))
             # 2) fast path: capacity now, nobody queued ahead
             if (self._inflight < cfg.max_inflight and not len(self._queue)
-                    and self._capacity_ok_locked(cost)):
+                    and self._capacity_ok_locked(cost, model)):
                 self._inflight += 1
+                self.model_inflight[model] = (
+                    self.model_inflight.get(model, 0) + 1)
                 self._note_pressure_locked()
-                return AdmissionTicket(self, tenant, cost, 0.0), None
+                return AdmissionTicket(self, tenant, cost, 0.0, model), None
             # 3) deadline-aware early rejection: don't queue a request
             # that cannot finish in time
             if deadline is not None:
@@ -409,7 +453,7 @@ class AdmissionController:
                 victim.event.set()
             # 5) deficit-round-robin fair queue
             self._seq += 1
-            w = _Waiter(tenant, cost, priority, deadline, self._seq)
+            w = _Waiter(tenant, cost, priority, deadline, self._seq, model)
             self._queue.push(w)
             self.peak_queue_depth = max(self.peak_queue_depth,
                                         len(self._queue))
@@ -463,19 +507,26 @@ class AdmissionController:
                     retry_after_ms=0)
                 w.event.set()
                 continue
-            if not self._capacity_ok_locked(w.cost):
+            if not self._capacity_ok_locked(w.cost, w.model):
                 self._queue.requeue_front(w, refund=w.cost)
                 break
             self._inflight += 1
+            self.model_inflight[w.model] = (
+                self.model_inflight.get(w.model, 0) + 1)
             w.ticket = AdmissionTicket(
                 self, w.tenant, w.cost,
-                time.perf_counter() - w.t_enqueue)
+                time.perf_counter() - w.t_enqueue, w.model)
             w.event.set()
 
     def _on_release(self, ticket: AdmissionTicket) -> None:
         hold_s = time.perf_counter() - ticket._t_admit
         with self._lock:
             self._inflight -= 1
+            n = self.model_inflight.get(ticket.model, 0) - 1
+            if n > 0:
+                self.model_inflight[ticket.model] = n
+            else:
+                self.model_inflight.pop(ticket.model, None)
             # EWMA of observed service time feeds the wait predictor
             self._service_ewma = (hold_s if self._service_ewma is None
                                   else 0.8 * self._service_ewma

@@ -107,6 +107,14 @@ class InferenceMetrics:
         self.queue_depth = Gauge(
             f"{ns}_queue_depth", "In-flight requests (NVRPC_METRICS hook)",
             registry=self.registry)
+        # -- per-model dimension (multi-model serving) ----------------------
+        self.model_requests = Counter(
+            f"{ns}_requests_by_model", "Requests completed, per model",
+            ["model"], registry=self.registry)
+        self.model_request_seconds = Histogram(
+            f"{ns}_request_duration_seconds_by_model",
+            "Request latency distribution, per model",
+            ["model"], buckets=E2E_BUCKETS, registry=self.registry)
         # quantile refresh cadence state: counter + lock live here (not
         # lazily in observe_request) so two racing observers cannot both
         # read a stale count and both skip the refresh
@@ -117,8 +125,13 @@ class InferenceMetrics:
     # -- observation hooks ---------------------------------------------------
     _REFRESH_EVERY = 64  # quantile refresh cadence (full reservoir sort)
 
-    def observe_request(self, request_s: float, compute_s: float) -> None:
+    def observe_request(self, request_s: float, compute_s: float,
+                        model: Optional[str] = None) -> None:
         self.request_count.inc()
+        if model:
+            self.model_requests.labels(model=model).inc()
+            self.model_request_seconds.labels(model=model).observe(
+                max(0.0, request_s))
         self.request_seconds_sum.inc(request_s)
         self.compute_seconds_sum.inc(compute_s)
         self._request.observe(request_s)
@@ -310,11 +323,15 @@ class GenerationMetrics:
     interpolation)."""
 
     def __init__(self, namespace: str = "tpulab",
-                 registry: Optional["CollectorRegistry"] = None):
+                 registry: Optional["CollectorRegistry"] = None,
+                 model: str = ""):
         if not HAVE_PROMETHEUS:  # pragma: no cover
             raise RuntimeError("prometheus_client unavailable")
         self.registry = registry or CollectorRegistry()
         ns = namespace
+        #: model name tagging this engine's per-model samples (multi-model
+        #: serving: one GenerationMetrics per engine; "" = untagged)
+        self.model_label = model
         self.active_lanes = Gauge(
             f"{ns}_llm_active_lanes", "Decode lanes in use",
             registry=self.registry)
@@ -416,6 +433,22 @@ class GenerationMetrics:
             "Already-delivered tokens a resume admission did NOT re-decode "
             "(each rode the prefill instead of a sequential decode step)",
             registry=self.registry)
+        # -- per-model dimension (multi-model serving) ----------------------
+        self.model_tokens = Counter(
+            f"{ns}_llm_tokens_by_model", "Tokens generated, per model",
+            ["model"], registry=self.registry)
+        self.model_completed = Counter(
+            f"{ns}_llm_requests_completed_by_model",
+            "Generation requests completed, per model",
+            ["model"], registry=self.registry)
+        self.model_ttft = Histogram(
+            f"{ns}_llm_ttft_seconds_by_model",
+            "Time to first token, per model",
+            ["model"], buckets=TTFT_BUCKETS, registry=self.registry)
+        self.model_itl = Histogram(
+            f"{ns}_llm_inter_token_seconds_by_model",
+            "Inter-token latency, per model",
+            ["model"], buckets=ITL_BUCKETS, registry=self.registry)
         self._ttft_res = _Reservoir()
         self._itl_res = _Reservoir()
         self._last: Dict[str, int] = {}
@@ -427,11 +460,15 @@ class GenerationMetrics:
     def observe_ttft(self, seconds: float) -> None:
         seconds = max(0.0, seconds)
         self.ttft.observe(seconds)
+        if self.model_label:
+            self.model_ttft.labels(model=self.model_label).observe(seconds)
         self._ttft_res.observe(seconds)
 
     def observe_itl(self, seconds: float) -> None:
         seconds = max(0.0, seconds)
         self.itl.observe(seconds)
+        if self.model_label:
+            self.model_itl.labels(model=self.model_label).observe(seconds)
         self._itl_res.observe(seconds)
 
     def observe_e2e(self, seconds: float) -> None:
@@ -474,6 +511,12 @@ class GenerationMetrics:
         self._advance(self.tokens, "tokens", batcher.tokens_generated)
         self._advance(self.completed, "completed",
                       batcher.completed_requests)
+        if self.model_label:
+            self._advance(self.model_tokens.labels(model=self.model_label),
+                          "model_tokens", batcher.tokens_generated)
+            self._advance(
+                self.model_completed.labels(model=self.model_label),
+                "model_completed", batcher.completed_requests)
         self._advance(self.preemptions, "preempt", batcher.preemptions)
         # fused-decode dispatch efficiency (getattr: wrapped engines may
         # not expose the counters)
@@ -622,6 +665,112 @@ class KVTierMetrics:
         self._advance(self.host_evictions, "evict", store.evictions)
         self.host_bytes.set(store.bytes_used)
         self.host_entries.set(len(store))
+
+
+class ModelStoreMetrics:
+    """Multi-model weight-tier telemetry (`_modelstore_*`;
+    tpulab.modelstore): resident-vs-host-tier model gauges, weight swap
+    in/out counters + latency distributions, evictions and cold rebuilds
+    — the view that says whether the hot set is cycling cheaply
+    (swap-ins, bounded latency) or thrashing back to cold rebuilds
+    (failures + rebuilds).  Latency/bytes are event-driven (pass this
+    object as the multiplexer's ``metrics=``); counters/gauges advance
+    via :meth:`poll`."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.resident_models = Gauge(
+            f"{ns}_modelstore_resident_models",
+            "Models currently HBM-resident (hot)", registry=self.registry)
+        self.host_tier_models = Gauge(
+            f"{ns}_modelstore_host_tier_models",
+            "Models parked in the host weight tier (cold)",
+            registry=self.registry)
+        self.hbm_bytes = Gauge(
+            f"{ns}_modelstore_hbm_bytes",
+            "Weight bytes accounted against the HBM budget (hot models "
+            "plus unsettled swaps)", registry=self.registry)
+        self.host_bytes = Gauge(
+            f"{ns}_modelstore_host_bytes",
+            "Host-tier weight bytes resident", registry=self.registry)
+        self.swap_ins = Counter(
+            f"{ns}_modelstore_swap_ins",
+            "Models promoted host->device (bit-exact weight restores)",
+            registry=self.registry)
+        self.swap_outs = Counter(
+            f"{ns}_modelstore_swap_outs",
+            "Model weight snapshots landed device->host (write-behind)",
+            registry=self.registry)
+        self.swap_in_bytes = Counter(
+            f"{ns}_modelstore_swap_in_bytes",
+            "Weight bytes copied host->device", registry=self.registry)
+        self.swap_out_bytes = Counter(
+            f"{ns}_modelstore_swap_out_bytes",
+            "Weight bytes copied device->host", registry=self.registry)
+        self.swap_in_seconds = Histogram(
+            f"{ns}_modelstore_swap_in_seconds",
+            "Swap-in latency (host pop -> weights attached)",
+            buckets=SWAP_BUCKETS, registry=self.registry)
+        self.swap_out_seconds = Histogram(
+            f"{ns}_modelstore_swap_out_seconds",
+            "Swap-out latency (detach -> host-tier resident; write-"
+            "behind, so this is BEHIND the request path)",
+            buckets=SWAP_BUCKETS, registry=self.registry)
+        self.evictions = Counter(
+            f"{ns}_modelstore_evictions",
+            "Models pushed out of HBM by budget pressure",
+            registry=self.registry)
+        self.cold_rebuilds = Counter(
+            f"{ns}_modelstore_cold_rebuilds",
+            "Acquires served by a fresh build (weights in no tier: "
+            "degraded swaps, host-budget refusals)",
+            registry=self.registry)
+        self.swap_failures = Counter(
+            f"{ns}_modelstore_swap_failures",
+            "Weight swaps degraded to the cold-rebuild path (chaos, "
+            "transfer errors)", registry=self.registry)
+        self.swap_drops = Counter(
+            f"{ns}_modelstore_swap_drops",
+            "Weight snapshots the host tier's budget refused (sustained "
+            "count = host budget undersized)", registry=self.registry)
+        self.host_evictions = Counter(
+            f"{ns}_modelstore_host_evictions",
+            "Host-tier LRU models pushed out by budget pressure",
+            registry=self.registry)
+        self._last: Dict[str, int] = {}
+
+    # -- event hooks (called by WeightMultiplexer) ---------------------------
+    def observe_swap_in(self, seconds: float, nbytes: int) -> None:
+        self.swap_in_seconds.observe(max(0.0, seconds))
+
+    def observe_swap_out(self, seconds: float, nbytes: int) -> None:
+        self.swap_out_seconds.observe(max(0.0, seconds))
+
+    def _advance(self, counter, key: str, value: int) -> None:
+        delta = value - self._last.get(key, 0)
+        if delta > 0:
+            counter.inc(delta)
+        self._last[key] = value
+
+    def poll(self, mux) -> None:
+        """Sample a WeightMultiplexer (control-loop / poller hook)."""
+        self._advance(self.swap_ins, "si", mux.swap_ins)
+        self._advance(self.swap_outs, "so", mux.swap_outs)
+        self._advance(self.swap_in_bytes, "sib", mux.swap_in_bytes)
+        self._advance(self.swap_out_bytes, "sob", mux.swap_out_bytes)
+        self._advance(self.evictions, "ev", mux.evictions)
+        self._advance(self.cold_rebuilds, "cr", mux.cold_rebuilds)
+        self._advance(self.swap_failures, "sf", mux.swap_failures)
+        self._advance(self.swap_drops, "sd", mux.swap_drops)
+        self._advance(self.host_evictions, "he", mux.store.evictions)
+        self.resident_models.set(len(mux.resident_models()))
+        self.host_tier_models.set(len(mux.host_models()))
+        self.hbm_bytes.set(mux.hbm_bytes_in_use)
+        self.host_bytes.set(mux.store.bytes_used)
 
 
 class AdmissionMetrics:
