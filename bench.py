@@ -858,6 +858,27 @@ def main() -> None:
     except Exception as e:
         print(f"# multi model row skipped: {e!r}", file=sys.stderr)
 
+    # unified HBM economy (docs/PERFORMANCE.md "HBM economy"): a mixed
+    # model-swap + KV-burst trace under device-HBM oversubscription —
+    # the budget holds EITHER the burst's grown page pool OR the second
+    # model's weights, never both.  Arbiter on (the pool grows by
+    # evicting the cold model; a model acquire demotes idle KV and
+    # shrinks the pool back) vs today's static split (fixed small pool,
+    # model always resident, burst serialized).  The claims tracked:
+    # goodput >= the static split under mixed pressure, both pressure
+    # directions fire (demotions AND evictions > 0), and tokens/outputs
+    # are bit-identical in both modes (parity).
+    _phase("hbm_arbiter")
+    try:
+        from tpulab.hbm import benchmark_hbm_arbiter
+        # degraded trims the trace, never the geometry: pool-size ladder
+        # and capacity derive from (steps, lanes, page_size), and the
+        # warm phase covers exactly those shapes
+        _record(hbm_arbiter=benchmark_hbm_arbiter(
+            n_llm=8 if degraded else 12))
+    except Exception as e:
+        print(f"# hbm arbiter row skipped: {e!r}", file=sys.stderr)
+
     # disaggregated prefill/decode (docs/SERVING.md "Replica roles"):
     # the same prefill-heavy trace served by one unified pool vs a
     # prefill replica shipping finished KV over the host tier's wire

@@ -634,6 +634,7 @@ def test_poll_load_reads_status_gauges():
         rs = ReplicaSet([addr], "lm")
         load = rs.poll_load()
         assert load[addr] == {"queued_requests": 0, "free_kv_pages": 0,
+                              "free_hbm_bytes": 0,  # no arbiter served
                               "role": "unified",
                               "resident_models": [], "host_models": []}
         assert rs._load_hint == [0]

@@ -139,6 +139,16 @@ def test_gen_inference_pb2_schema_drift_and_roundtrip():
     assert list(pb.StatusResponse().resident_models) == []  # no modelstore
     assert pb.GenerateRequest().resume_length == 0  # absent = fresh request
 
+    # unified HBM economy (tpulab.hbm): the single arbiter headroom gauge
+    # rides Status next to free_kv_pages; int64 so an over-committed
+    # (negative) discovery reports honestly
+    hb = pb.StatusResponse.FromString(pb.StatusResponse(
+        free_hbm_bytes=123456789).SerializeToString())
+    assert hb.free_hbm_bytes == 123456789
+    assert pb.StatusResponse.FromString(pb.StatusResponse(
+        free_hbm_bytes=-4096).SerializeToString()).free_hbm_bytes == -4096
+    assert pb.StatusResponse().free_hbm_bytes == 0  # no arbiter served
+
 
 # -- capture policy (stubbed attempts; no device needed) ----------------------
 def _bc(monkeypatch, recs):

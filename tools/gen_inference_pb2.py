@@ -128,6 +128,11 @@ def build_file() -> dp.FileDescriptorProto:
         # (no swap-in on the request path).
         field("resident_models", 7, F.TYPE_STRING, REP),
         field("host_models", 8, F.TYPE_STRING, REP),
+        # unified HBM economy (tpulab.hbm): the ONE honest device-memory
+        # headroom number — ledger capacity minus every tenant's claims
+        # (weights + KV pages + compiled scratch).  0 = no arbiter;
+        # negative = over-committed discovery (scratch measured late).
+        field("free_hbm_bytes", 9, F.TYPE_INT64),
     ])
 
     fd.message_type.add(name="HealthRequest")
@@ -269,6 +274,13 @@ def main() -> int:
         "mr = pb.StatusResponse.FromString(mr.SerializeToString());"
         "assert list(mr.resident_models) == ['llm', 'vit_s16'];"
         "assert list(mr.host_models) == ['transformer_int8'];"
+        "hb = pb.StatusResponse(free_hbm_bytes=123456789);"
+        "hb = pb.StatusResponse.FromString(hb.SerializeToString());"
+        "assert hb.free_hbm_bytes == 123456789;"
+        "nb = pb.StatusResponse(free_hbm_bytes=-4096);"
+        "assert pb.StatusResponse.FromString("
+        "nb.SerializeToString()).free_hbm_bytes == -4096;"
+        "assert pb.StatusResponse().free_hbm_bytes == 0;"
         "dq = pb.GenerateRequest(prompt=[1], steps=2, prefill_only=True,"
         " kv_shipment=b'blob');"
         "dq = pb.GenerateRequest.FromString(dq.SerializeToString());"

@@ -41,7 +41,7 @@ class InferenceManager(_EngineManager):
               models=None, modelstore=None,
               model_hbm_budget: Optional[int] = None,
               model_host_budget: Optional[int] = None,
-              pinned_models=()) -> "InferenceManager":
+              pinned_models=(), hbm=None) -> "InferenceManager":
         """Expose registered models over the TRTIS-style gRPC service
         (reference manager.serve() -> BasicInferService).  ``batching=True``
         enables server-side dynamic batching across concurrent callers;
@@ -66,7 +66,13 @@ class InferenceManager(_EngineManager):
         and requests swap their model hot on demand; ``pinned_models``
         stay permanently resident.  Pass an existing ``modelstore`` to
         share one multiplexer with generation engines registered via
-        :class:`tpulab.modelstore.BatcherAdapter`."""
+        :class:`tpulab.modelstore.BatcherAdapter`.
+
+        ``hbm=HBMArbiter(...)`` (tpulab.hbm) arms the unified device-
+        memory economy: pass the same arbiter to the engines/modelstore
+        that rent from it — the Status RPC then reports the single
+        ``free_hbm_bytes`` headroom and an attached admission controller
+        adopts it (docs/PERFORMANCE.md "HBM economy")."""
         builders = {}
         if models:
             from tpulab.models.registry import build_model
@@ -101,7 +107,8 @@ class InferenceManager(_EngineManager):
             self, f"0.0.0.0:{port}", executor=executor, batching=batching,
             batch_window_s=batch_window_s, metrics=metrics, trace=trace,
             generation_engines=generation_engines, watchdog=watchdog,
-            admission=admission, role=role, modelstore=modelstore)
+            admission=admission, role=role, modelstore=modelstore,
+            hbm=hbm)
         if wait:
             self._server.run()
         else:

@@ -82,6 +82,16 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
                               swap-in discard the host copy and serve a
                               cold rebuild instead: degraded weights are
                               always REBUILT weights, never a corrupt serve
+    hbm.pressure              HBMArbiter decision sites (tpulab.hbm): one
+                              trip per pressed tenant per pressure round
+                              (demote-KV, evict-model) and one at the
+                              denial — error/drop suppress that decision,
+                              so the requester degrades to its pre-arbiter
+                              static-budget behavior (the mux waits on its
+                              own budget, the batcher queues on its current
+                              pool).  The ledger is never touched on a
+                              tripped path: chaos can forgo the
+                              optimization, never corrupt the accounting
 """
 
 from __future__ import annotations

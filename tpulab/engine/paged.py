@@ -95,6 +95,10 @@ class PagedKVPool:
         self._free: List[int] = list(range(1, n_pages))
         self._refs: Dict[int, int] = {}  # live page -> refcount
         self._lock = threading.Lock()
+        #: allocate lowest page ids first (the HBM arbiter arms this):
+        #: live data packs toward page 0, so the TOP of the store stays
+        #: contiguously free and :meth:`shrink` can return real bytes
+        self.prefer_low_pages = False
 
     # the KV buffer rotates through XLA donation; the setter keeps the
     # device allocator's accounting slot pointing at the live generation
@@ -158,6 +162,12 @@ class PagedKVPool:
             self._kv = None
 
     @property
+    def page_nbytes(self) -> int:
+        """Tracked HBM bytes one logical page costs (every layer's K+V
+        rows for its slots) — the ledger/admission conversion factor."""
+        return self.hbm_bytes // max(1, self.n_pages)
+
+    @property
     def free_pages(self) -> int:
         with self._lock:
             return len(self._free)
@@ -166,7 +176,11 @@ class PagedKVPool:
         with self._lock:
             if not self._free:
                 return None
-            page = self._free.pop()
+            if self.prefer_low_pages:
+                page = min(self._free)
+                self._free.remove(page)
+            else:
+                page = self._free.pop()
             self._refs[page] = 1
             return page
 
@@ -197,6 +211,68 @@ class PagedKVPool:
         """Current reference count (0 for free/unknown pages)."""
         with self._lock:
             return self._refs.get(page, 0)
+
+    # -- elastic capacity (the HBM economy, tpulab.hbm) ----------------------
+    # The page store is no longer a fixed pre-carve: under an arbiter the
+    # batcher grows it when a KV burst wins bytes from the other tenants
+    # and shrinks it when a model's residency squeezes KV back.  Both ops
+    # re-materialize the store through the tracked allocator's replace()
+    # slot, so the framework HBM gauge (and the ledger claim mirroring
+    # it) follows the real byte count exactly.  Page ids are STABLE:
+    # grow appends ids, shrink only drops contiguously free ids off the
+    # top — no live block table ever needs remapping.
+    def shrinkable_pages(self) -> int:
+        """Free pages contiguously at the TOP of the store — the ids a
+        shrink could drop right now without touching live data."""
+        with self._lock:
+            free = set(self._free)
+            n = 0
+            p = self.n_pages - 1
+            while p >= 1 and p in free:
+                n += 1
+                p -= 1
+            return n
+
+    def grow(self, extra_pages: int) -> int:
+        """Append ``extra_pages`` zeroed pages to the store (one device
+        concat through the allocator's accounting slot).  Returns the
+        pages added.  Scheduler-thread only, like every other mutation of
+        the live ``kv`` buffer."""
+        extra = int(extra_pages)
+        if extra <= 0:
+            return 0
+        import jax
+        import jax.numpy as jnp
+        pad_shape = (self._shape[0], extra) + self._shape[2:]
+        pad = jax.device_put(jnp.zeros(pad_shape, self._dtype),
+                             self.placement)
+        self.kv = jnp.concatenate([self._kv, pad], axis=1)
+        with self._lock:
+            self._free.extend(range(self.n_pages, self.n_pages + extra))
+            self.n_pages += extra
+            self._shape = (self._shape[0], self.n_pages) + self._shape[2:]
+        return extra
+
+    def shrink(self, drop_pages: int) -> int:
+        """Drop up to ``drop_pages`` contiguously free pages off the TOP
+        of the store (one device slice through the accounting slot).
+        Returns the pages actually dropped — capped by what is free at
+        the top; never page 0, never a live id."""
+        with self._lock:
+            free = set(self._free)
+            k = 0
+            p = self.n_pages - 1
+            while p >= 1 and p in free and k < int(drop_pages):
+                k += 1
+                p -= 1
+            if k == 0:
+                return 0
+            cut = self.n_pages - k
+            self._free = [q for q in self._free if q < cut]
+            self.n_pages = cut
+            self._shape = (self._shape[0], cut) + self._shape[2:]
+        self.kv = self._kv[:, :cut]
+        return k
 
 
 @functools.lru_cache(maxsize=None)
@@ -1129,7 +1205,7 @@ class ContinuousBatcher:
                  draft_n_heads: Optional[int] = None,
                  draft_n_kv_heads: Optional[int] = None,
                  spec_accept_floor: float = 0.35,
-                 mesh=None):
+                 mesh=None, hbm=None):
         import jax
         import jax.numpy as jnp
 
@@ -1163,6 +1239,27 @@ class ContinuousBatcher:
         if pool is not None and mesh is not None and pool.mesh is not mesh:
             raise ValueError("provided pool was built on a different mesh "
                              "than the batcher's")
+        # unified HBM economy (tpulab.hbm, docs/PERFORMANCE.md "HBM
+        # economy"): with an arbiter the batcher is the KV TENANT — the
+        # pool's page store becomes elastic (a KV burst wins bytes from
+        # cold models via the arbiter's pressure protocol; a hot model's
+        # acquire squeezes idle KV down to the host tier), and every jit
+        # this engine compiles records its scratch with the ledger.  Set
+        # before the first _jit so scratch measuring can wrap them.
+        self.hbm = hbm
+        self._hbm_reclaim_bytes = 0  # outstanding arbiter reclaim target
+        self.hbm_grows = 0           # pool grow ops granted by the arbiter
+        self.hbm_shrinks = 0         # pool shrink ops under pressure
+        self.hbm_demotions = 0       # lanes demoted (preempted) by pressure
+        #: elastic pool sizes snap to a geometric ladder off the initial
+        #: size (n0, 2*n0, 4*n0, ...) — every pool shape recompiles the
+        #: fused programs, so sizes must come from a bounded menu the
+        #: warm-up can cover (the BLOCK_K_MENU / pow2-prefill-bucket
+        #: discipline applied to capacity)
+        self._hbm_pool_base = self.pool.n_pages
+        self._hbm_starved_passes = 0  # hold-and-wait breaker streak
+        if hbm is not None:
+            self.pool.prefer_low_pages = True
         # sharded serving (docs/PERFORMANCE.md "Sharded serving"): with a
         # ``mesh`` ({"model": M}, tpulab.parallel) one replica serves a
         # model sharded over M devices — params placed by the Megatron-TP
@@ -1370,6 +1467,15 @@ class ContinuousBatcher:
         self._active: List[Optional[_PagedRequest]] = [None] * lanes
         self._admit_counter = 0
         self.preemptions = 0
+        if self.hbm is not None:
+            # register as the KV tenant AFTER kv_offload is settled (the
+            # reclaimable estimate reads it) and claim the page store's
+            # tracked bytes — the ledger now mirrors the allocator gauge
+            from tpulab.hbm import KV_TENANT
+            self.hbm.register(KV_TENANT, reclaim=self._hbm_reclaim,
+                              reclaimable=self._hbm_reclaimable,
+                              gauge=lambda: self.pool.hbm_bytes)
+            self.hbm.mirror_claim(KV_TENANT, "pool", self.pool.hbm_bytes)
         self.completed_requests = 0  # futures resolved successfully
         self.tokens_generated = 0    # emitted across all requests
         self._cv = threading.Condition()
@@ -1383,12 +1489,23 @@ class ContinuousBatcher:
         partitioner then inserts the collectives (psum after row-parallel
         matmuls, gathers where layouts demand) INSIDE the compiled
         program — and a plain single-device jit otherwise (``in_sh`` /
-        ``out_sh`` ignored; mesh=None is exactly the pre-mesh build)."""
+        ``out_sh`` ignored; mesh=None is exactly the pre-mesh build).
+
+        With an arbiter measuring scratch, the jit is wrapped so each
+        distinct shape signature records its compile-time temp bytes as
+        a ``("scratch", ...)`` ledger claim (tpulab.hbm.scratch) — the
+        third tenant the pre-arbiter headroom math never saw."""
         import jax
         if self.mesh is None:
-            return jax.jit(fn, donate_argnums=donate)
-        return jax.jit(fn, donate_argnums=donate,
-                       in_shardings=in_sh, out_shardings=out_sh)
+            jitted = jax.jit(fn, donate_argnums=donate)
+        else:
+            jitted = jax.jit(fn, donate_argnums=donate,
+                             in_shardings=in_sh, out_shardings=out_sh)
+        if self.hbm is not None and self.hbm.measure_scratch:
+            from tpulab.hbm import MeasuredJit
+            name = getattr(getattr(fn, "func", fn), "__name__", "jit")
+            jitted = MeasuredJit(jitted, self.hbm, name)
+        return jitted
 
     def _build_prefill(self, flash: bool):
         """Jitted fused prefill, compiled per prompt-length bucket (powers
@@ -1575,6 +1692,9 @@ class ContinuousBatcher:
             self.kv_offload.close()  # drain write-behind, free host tier
         if self._owns_pool and not self._thread.is_alive():
             self.pool.close()  # free the page stores' HBM eagerly
+            if self.hbm is not None:
+                from tpulab.hbm import KV_TENANT
+                self.hbm.release(KV_TENANT, "pool")
 
     @property
     def active_lanes(self) -> int:
@@ -1674,6 +1794,190 @@ class ContinuousBatcher:
         self.pool.kv = new_kv
         return page
 
+    # -- HBM economy (tpulab.hbm): the KV tenant --------------------------
+    #: bound on how long a blocking grow request waits for a write-behind
+    #: model eviction to land (only paid when every lane is starved —
+    #: the scheduler had nothing else to do anyway)
+    HBM_GROW_TIMEOUT_S = 0.5
+
+    def _page_nbytes(self) -> int:
+        return max(1, self.pool.page_nbytes)
+
+    def _hbm_ladder_down(self, total: int) -> int:
+        """Largest ladder size (base * 2^k) <= ``total`` (base floor)."""
+        size = self._hbm_pool_base
+        while size * 2 <= total:
+            size *= 2
+        return size
+
+    def _hbm_reclaimable(self) -> int:
+        """Non-mutating estimate of the KV bytes pressure could free:
+        pages already contiguously free at the top of the store, plus
+        idle prefix-cache pages, plus live-but-idle lane KV the host
+        tier could absorb (demotion needs ``kv_offload`` — without the
+        tier a preempted lane re-prefills, which frees pages but burns
+        recompute, so it is not advertised as cheap headroom)."""
+        pages = self.pool.shrinkable_pages()
+        if self.prefix_cache is not None:
+            pages += len(self.prefix_cache)
+        if self.kv_offload is not None:
+            with self._cv:
+                lane_pages = sum(len(r.pages) for r in self._active
+                                 if r is not None)
+            pages = pages + min(lane_pages,
+                                self.kv_offload.headroom_pages())
+        return pages * self._page_nbytes()
+
+    def _hbm_reclaim(self, nbytes: int) -> int:
+        """Arbiter pressure hook (foreign thread): record the target and
+        wake the scheduler — demotion/preemption/shrink run at the next
+        tick boundary, where no dispatched block is in flight.  Returns
+        the bytes this tenant expects to free (its progress promise)."""
+        est = min(int(nbytes), self._hbm_reclaimable())
+        if est <= 0:
+            return 0
+        with self._cv:
+            self._hbm_reclaim_bytes = max(self._hbm_reclaim_bytes,
+                                          int(nbytes))
+            self._cv.notify()
+        return est
+
+    def _service_hbm_locked(self) -> None:
+        """Serve an outstanding arbiter reclaim at the tick boundary:
+        demote idle prefix-cache KV to the host tier, preempt
+        live-but-idle lanes (their KV swaps out through the existing
+        preemption path — the resumed stream is bit-exact), then shrink
+        the page store's top and release the bytes to the ledger.  Only
+        runs with no dispatched-ahead block in flight, so no in-flight
+        decode page is ever victimized."""
+        need = self._hbm_reclaim_bytes
+        if not need or self.hbm is None or self._pending_block is not None:
+            return
+        from tpulab.hbm import KV_TENANT
+        pn = self._page_nbytes()
+        target = (need + pn - 1) // pn
+        # snap the post-shrink total onto the size ladder (bounded
+        # compiled shapes): free at least the target, landing on the
+        # largest ladder size at or below what remains
+        target = max(target, self.pool.n_pages
+                     - self._hbm_ladder_down(
+                         max(1, self.pool.n_pages - target)))
+        # 1) idle KV first: cold prefix-cache entries demote for free
+        while (self.pool.shrinkable_pages() < target
+               and self.prefix_cache is not None
+               and self.prefix_cache.evict_for_alloc()):
+            pass
+        # 2) live-but-idle lanes: preempt coldest-priority, least-progress
+        # first — with kv_offload their KV demotes to the host tier and
+        # the resume is recompute-free; without it the resume re-prefills
+        # (the pre-arbiter preemption contract either way)
+        while self.pool.shrinkable_pages() < target:
+            victims = [(req.priority, -req.admit_seq, lane)
+                       for lane, req in enumerate(self._active)
+                       if req is not None]
+            if not victims:
+                break
+            _, _, lane = min(victims)
+            self._preempt_locked(lane)
+            self.hbm_demotions += 1
+        dropped = self.pool.shrink(target)
+        self._hbm_reclaim_bytes = 0
+        if dropped:
+            self.hbm_shrinks += 1
+            self.hbm.mirror_claim(KV_TENANT, "pool", self.pool.hbm_bytes)
+
+    def _hbm_break_hoard_locked(self) -> None:
+        """Preempt the most recently admitted lane when every lane is
+        starved with nothing free — the hold-and-wait breaker for the
+        elastic regime (see the _run call site).  The victim resumes
+        exactly (preemption contract); progress resumes immediately."""
+        if self.pool.free_pages > 0:
+            return
+        active = [(req.admit_seq, lane)
+                  for lane, req in enumerate(self._active)
+                  if req is not None and req.pages]
+        if len(active) < 2:
+            return  # one holder is not a hold-and-wait cycle
+        _, lane = max(active)
+        self._preempt_locked(lane)
+        self.hbm_demotions += 1
+        # the starvation streak stays up until a tick makes real
+        # progress: admission is suppressed meanwhile (_admit_locked), so
+        # the victim cannot re-admit and re-form the cycle before the
+        # surviving holders finish
+
+    def _hbm_maybe_grow(self, block: bool) -> bool:
+        """Per-tick grow probe (scheduler thread, no locks held): when
+        queued or starved requests want more pages than the pool holds,
+        ask the arbiter for the bytes — the pressure protocol may evict
+        a cold model to supply them.  ``block=True`` (every lane starved:
+        nothing else to do) waits briefly for write-behind evictions to
+        land; probes are free and retried next tick otherwise."""
+        if self.hbm is None:
+            return False
+        with self._cv:
+            if self._hbm_reclaim_bytes or self._pending_block is not None:
+                return False  # being squeezed (or a block in flight)
+            ps = self.page_size
+            want = 0
+            for req in self._queue[:self.lanes]:
+                if req.kv_handle is not None:
+                    want += req.kv_handle.n_pages + 1
+                else:
+                    t = len(req.pending_prompt) or (len(req.prompt)
+                                                    + len(req.tokens_out))
+                    want += (t + req.steps - len(req.tokens_out)
+                             + ps - 1) // ps + 1
+            for req in self._active:
+                if req is None:
+                    continue
+                if req.pending_prompt:  # starved prefill / pending resume
+                    want += max(0, (len(req.pending_prompt) + ps - 1) // ps
+                                + 1 - len(req.pages))
+                else:  # decoding: pages its remaining appends will write
+                    need = (req.length + req.steps - len(req.tokens_out)
+                            + ps - 1) // ps
+                    want += max(0, need - len(req.pages))
+            deficit = want - self.pool.free_pages
+        if deficit <= 0:
+            return False
+        from tpulab.hbm import KV_TENANT
+        pn = self._page_nbytes()
+        # ask only for what the economy could plausibly supply (free
+        # headroom + what pressure could evict) — an oversized request
+        # would deny forever instead of growing incrementally — and snap
+        # the new total onto the size ladder (bounded compiled shapes):
+        # the smallest ladder size covering the demand we can afford,
+        # else the largest affordable step toward it
+        avail = (max(0, self.hbm.free_hbm_bytes)
+                 + self.hbm.reclaimable_bytes(exclude=KV_TENANT))
+        n = self.pool.n_pages
+        affordable = n + avail // pn  # a rung may cost more than the
+        #                               deficit — affordability is what
+        #                               the economy could supply, period
+        target = self._hbm_pool_base
+        while target < n + deficit and target * 2 <= affordable:
+            target *= 2
+        pages = target - n
+        if pages <= 0:
+            return False  # static-budget degrade: queue on today's pool
+        granted = self.hbm.request(
+            KV_TENANT, ("pool", "grow"), pages * pn,
+            timeout=self.HBM_GROW_TIMEOUT_S if block else 0.0,
+            probe=not block)
+        if not granted:
+            return False
+        with self._cv:
+            if self._pending_block is None:
+                self.pool.grow(pages)
+                self.hbm_grows += 1
+            # consolidate: fold the grant into the pool claim (mirror
+            # first so the total never dips below the tracked bytes)
+            self.hbm.mirror_claim(KV_TENANT, "pool", self.pool.hbm_bytes)
+            self.hbm.release(KV_TENANT, ("pool", "grow"))
+            self._cv.notify()
+        return True
+
     def _admit_to_lane_locked(self, lane: int) -> bool:
         """Admit the queue head into a free lane (needs at least one page
         to start); False when the pool can't supply it."""
@@ -1688,10 +1992,19 @@ class ContinuousBatcher:
         return True
 
     def _admit_locked(self) -> None:
-        for lane in range(self.lanes):
-            if self._active[lane] is None and self._queue:
-                if not self._admit_to_lane_locked(lane):
-                    break
+        # elastic-regime hold-and-wait breaker (tpulab.hbm): while the
+        # scheduler is in a starvation streak WITH live page-holders,
+        # feed the pages freed by _hbm_break_hoard_locked to those
+        # holders instead of re-admitting — the preempted victim
+        # re-enters once decoding progresses.  With no holders at all
+        # (e.g. right after an arbiter squeeze emptied every lane),
+        # admission must proceed or nothing ever runs again.
+        if not (self.hbm is not None and self._hbm_starved_passes >= 2
+                and any(r is not None for r in self._active)):
+            for lane in range(self.lanes):
+                if self._active[lane] is None and self._queue:
+                    if not self._admit_to_lane_locked(lane):
+                        break
         # preemption: while the queue head strictly outranks the weakest
         # active request (priority tie-break: most recently admitted falls
         # first — least progress lost), evict it and admit the head.
@@ -1766,10 +2079,16 @@ class ContinuousBatcher:
         while True:
             with self._cv:
                 while (not self._shutdown and not self._queue
-                       and not any(self._active)):
+                       and not any(self._active)
+                       and not self._hbm_reclaim_bytes):
                     self._cv.wait()
                 if self._shutdown and not self._queue and not any(self._active):
                     return
+                # HBM arbiter pressure: serve an outstanding reclaim at
+                # the tick boundary (no dispatched block is in flight
+                # here — dispatch-ahead is suppressed while a reclaim is
+                # pending, so in-flight decode pages are never victims)
+                self._service_hbm_locked()
                 # cancellation + deadline sweep: unconditional, so cancels
                 # and expiries land even when no lane can make progress
                 # (page-starved prefills).  Expired requests free their
@@ -1832,11 +2151,32 @@ class ContinuousBatcher:
                             self.completed_requests += 1
                             self._note_complete(req)
                 progressed = self._tick(snapshot, jnp) or prefilled
+                if self.hbm is not None:
+                    # KV-burst side of the economy: queued/starved demand
+                    # asks the arbiter for pool bytes (a cold model may be
+                    # evicted to supply them); a cheap probe per tick,
+                    # blocking only when every lane is starved anyway
+                    self._hbm_maybe_grow(block=not progressed)
                 if not progressed:
+                    if self.hbm is not None:
+                        # elastic-regime hold-and-wait breaker: lanes are
+                        # sized for the GROWN pool, so a denied grow can
+                        # strand N partial page-holders where the static
+                        # world (lanes sized to the fixed pool) never
+                        # could.  After two fully-starved passes with
+                        # nothing free, preempt the newest lane (exact
+                        # resume) so the eldest can finish — degraded
+                        # throughput, never a livelock.
+                        self._hbm_starved_passes += 1
+                        if self._hbm_starved_passes >= 2:
+                            with self._cv:
+                                self._hbm_break_hoard_locked()
                     # every lane starved (pool pressure): back off instead
                     # of hot-spinning until pages free up
                     with self._cv:
                         self._cv.wait(timeout=0.01)
+                else:
+                    self._hbm_starved_passes = 0
             except Exception as e:  # noqa: BLE001 - fail active requests
                 # a dispatched-ahead block died with the pool: its device
                 # arrays and lane mapping are meaningless after recovery
@@ -2496,7 +2836,8 @@ class ContinuousBatcher:
         # above, and its stale device writes only touch positions a new
         # page owner rewrites before reading.
         if (clean and not completed and k > 1
-                and self._pending_block is None and not self._shutdown):
+                and self._pending_block is None and not self._shutdown
+                and not self._hbm_reclaim_bytes):
             lanes_now = list(stash["lane_reqs"].items())
             # a lane that just re-armed speculation (a probe countdown
             # expiring above) must flow back through _plan_decode — a

@@ -226,10 +226,24 @@ class WeightMultiplexer:
     def __init__(self, hbm_budget_bytes: int,
                  store: Optional[HostParamStore] = None,
                  host_budget_bytes: int = DEFAULT_HOST_BUDGET,
-                 transfer=None, metrics=None):
+                 transfer=None, metrics=None, hbm=None):
         if hbm_budget_bytes <= 0:
             raise ValueError("hbm_budget_bytes must be > 0")
         self.hbm_budget_bytes = int(hbm_budget_bytes)
+        # unified HBM economy (tpulab.hbm): with an arbiter this store is
+        # the WEIGHTS tenant — acquires for a cold model request bytes
+        # through the pressure protocol (which may demote idle KV), a KV
+        # burst may press cold unleased models out, and every byte the
+        # internal accounting holds is mirrored as a ledger claim.  A
+        # denied request degrades to the static hbm_budget_bytes path —
+        # exactly the pre-arbiter behavior.
+        self._hbm = hbm
+        if hbm is not None:
+            from tpulab.hbm import WEIGHTS_TENANT
+            self._hbm_tenant = WEIGHTS_TENANT
+            hbm.register(WEIGHTS_TENANT, reclaim=self._hbm_reclaim,
+                         reclaimable=self._hbm_evictable_bytes,
+                         gauge=lambda: self.hbm_bytes_in_use)
         # identity check, not truthiness (an empty store is falsy)
         self.store = store if store is not None \
             else HostParamStore(host_budget_bytes)
@@ -280,12 +294,57 @@ class WeightMultiplexer:
             self._entries[name] = e
             if resident:
                 self._hbm_bytes += e.nbytes
-                self._trim_locked()
+                self._ledger_claim(e)
+                if self._hbm is None:
+                    # static budget: trim colder idle models to fit.  The
+                    # economy has no static split to trim to — residency
+                    # holds until another tenant's pressure presses it out
+                    self._trim_locked()
 
     def pin(self, name: str, on: bool = True) -> None:
         with self._cv:
             self._entries[name].pinned = bool(on)
             self._cv.notify_all()
+
+    # -- HBM economy (tpulab.hbm): the weights tenant ------------------------
+    def _ledger_claim(self, e: "_ModelEntry") -> None:
+        """Mirror a ``_hbm_bytes += e.nbytes`` into the device ledger —
+        called at every site that adds hot bytes, so per-model claims sum
+        exactly to this store's byte gauge (the verify() invariant)."""
+        if self._hbm is not None:
+            self._hbm.mirror_claim(self._hbm_tenant, e.name, e.nbytes)
+
+    def _ledger_release(self, e: "_ModelEntry") -> None:
+        if self._hbm is not None:
+            self._hbm.release(self._hbm_tenant, e.name)
+
+    def _hbm_evictable_bytes(self) -> int:
+        """Non-mutating estimate for the arbiter/admission: hot bytes a
+        pressure round could evict right now (unleased, unpinned, not
+        busy — the same floor can_admit stands on: leased and pinned
+        models are NEVER victims)."""
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values()
+                       if e.state == _HOT and not e.pinned and e.refs == 0
+                       and not e.adapter.busy())
+
+    def _hbm_reclaim(self, nbytes: int) -> int:
+        """Arbiter pressure hook: a KV burst (or scratch discovery) needs
+        device bytes — initiate write-behind swap-outs of cold unleased
+        models, coldest first, until the target is covered or nothing is
+        evictable.  Returns the bytes initiated (they land — and release
+        their ledger claims — on the transfer collector thread)."""
+        initiated = 0
+        with self._cv:
+            while initiated < int(nbytes):
+                victim = self._evictable_locked()
+                if victim is None:
+                    break
+                size = victim.nbytes
+                if not self._swap_out_locked(victim):
+                    break
+                initiated += size
+        return initiated
 
     # -- introspection -------------------------------------------------------
     def __contains__(self, name: str) -> bool:
@@ -333,8 +392,17 @@ class WeightMultiplexer:
                 v.nbytes for v in self._entries.values()
                 if v.state == _HOT and not v.pinned and v.refs == 0
                 and not v.adapter.busy())
-            return (self._hbm_bytes - evictable + e.nbytes
-                    <= self.hbm_budget_bytes)
+            nbytes = e.nbytes
+            if self._hbm is None:
+                return (self._hbm_bytes - evictable + nbytes
+                        <= self.hbm_budget_bytes)
+        # arbitrated: the economy's headroom — free ledger bytes plus what
+        # pressure on the OTHER tenants (demotable KV) plus own evictions
+        # could free — replaces the static-budget arithmetic
+        arb = self._hbm
+        return (max(0, arb.free_hbm_bytes)
+                + arb.reclaimable_bytes(exclude=self._hbm_tenant)
+                + evictable >= nbytes)
 
     # -- acquire / release ---------------------------------------------------
     def acquire(self, name: str, timeout: Optional[float] = None
@@ -345,6 +413,7 @@ class WeightMultiplexer:
         ``timeout`` and ``KeyError`` for an unregistered name."""
         end = _time.monotonic() + (self.ACQUIRE_TIMEOUT_S
                                    if timeout is None else timeout)
+        arbiter_denied = False
         with self._cv:
             e = self._entries[name]
             while True:
@@ -357,10 +426,32 @@ class WeightMultiplexer:
                     # still landing: wait for the state to settle
                     self._wait_locked(end, f"model {name!r} swap in flight")
                     continue
-                # COLD or LOST: claim the swap-in once headroom exists
+                # COLD or LOST: first let the economy decide (the arbiter
+                # may demote idle KV for these bytes); a denial degrades
+                # to the static hbm_budget_bytes path below for the rest
+                # of this acquire — the pre-arbiter behavior
+                if self._hbm is not None and not arbiter_denied:
+                    prior = e.state
+                    e.state = _SWAP_IN  # peers wait while we negotiate
+                    self._cv.release()
+                    try:
+                        granted = self._hbm.request(
+                            self._hbm_tenant, e.name, e.nbytes,
+                            timeout=max(0.0, end - _time.monotonic()))
+                    finally:
+                        self._cv.acquire()
+                    if granted:
+                        self._hbm_bytes += e.nbytes
+                        break
+                    e.state = prior
+                    arbiter_denied = True
+                    self._cv.notify_all()
+                    continue
+                # claim the swap-in once static headroom exists
                 if self._hbm_bytes + e.nbytes <= self.hbm_budget_bytes:
                     e.state = _SWAP_IN
                     self._hbm_bytes += e.nbytes
+                    self._ledger_claim(e)
                     break
                 # initiate evictions only beyond what in-flight swap-outs
                 # will already free when they land (write-behind: the
@@ -412,6 +503,7 @@ class WeightMultiplexer:
             with self._cv:
                 e.state = _LOST
                 self._hbm_bytes -= e.nbytes
+                self._ledger_release(e)
                 self._cv.notify_all()
             raise
         dt = _time.perf_counter() - t0
@@ -477,6 +569,7 @@ class WeightMultiplexer:
             del dev
             e.state = _LOST
             self._hbm_bytes -= e.nbytes
+            self._ledger_release(e)
             self.swap_failures += 1
             log.warning("model %s swap-out degraded (chaos %s): weights "
                         "dropped, next acquire cold-rebuilds", e.name, act)
@@ -522,6 +615,7 @@ class WeightMultiplexer:
             with self._cv:
                 e.state = _COLD if stored else _LOST
                 self._hbm_bytes -= e.nbytes
+                self._ledger_release(e)
                 self._pending_out_bytes -= e.nbytes
                 self._pending_ops -= 1
                 self._cv.notify_all()

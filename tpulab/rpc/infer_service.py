@@ -64,9 +64,14 @@ class InferResources(Resources):
                  batch_window_s: float = 0.002, metrics=None,
                  generation_engines: Optional[Dict[str, object]] = None,
                  watchdog=None, trace=None, admission=None,
-                 role: str = "unified", modelstore=None):
+                 role: str = "unified", modelstore=None, hbm=None):
         self.manager = manager
         self.metrics = metrics
+        #: optional tpulab.hbm.HBMArbiter — the unified device-memory
+        #: economy.  Status reports its single headroom number
+        #: (free_hbm_bytes) so routers and admission see ONE honest
+        #: figure instead of per-tenant estimates.  None = no arbiter.
+        self.hbm = hbm
         #: optional tpulab.modelstore.WeightMultiplexer — multi-model
         #: serving (docs/SERVING.md "Multi-model serving"): requests for
         #: a managed model acquire a lease (swap the weights in if cold,
@@ -205,6 +210,13 @@ class StatusContext(Context):
         resp.queued_requests = queued
         resp.free_kv_pages = free_pages
         resp.role = res.role
+        if res.hbm is not None:
+            # unified HBM economy (tpulab.hbm): ONE honest headroom
+            # gauge next to the per-pool page count
+            try:
+                resp.free_hbm_bytes = int(res.hbm.free_hbm_bytes)
+            except Exception:  # torn-down arbiter: report what we can
+                pass
         if res.modelstore is not None:
             # multi-model residency report: routers prefer a replica that
             # already has the requested model hot (no swap-in on path)
@@ -504,7 +516,8 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                         metrics=None,
                         generation_engines: Optional[Dict[str, object]] = None,
                         watchdog=None, trace=None, admission=None,
-                        role: str = "unified", modelstore=None) -> Server:
+                        role: str = "unified", modelstore=None,
+                        hbm=None) -> Server:
     """Wire the inference service onto a Server
     (reference BasicInferService ctor infer.cc:644-678).
 
@@ -522,7 +535,11 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     requests for a managed model lease its weights (swapped in from the
     host tier if cold, pinned hot for the request's duration) and Status
     reports resident vs host-tier models (docs/SERVING.md "Multi-model
-    serving")."""
+    serving").  ``hbm`` is an optional :class:`tpulab.hbm.HBMArbiter`:
+    the unified device-memory economy — Status reports its single
+    ``free_hbm_bytes`` headroom and an attached admission controller
+    adopts it for capacity decisions (docs/PERFORMANCE.md "HBM
+    economy")."""
     if admission is not None and trace is not None \
             and getattr(admission, "trace", None) is None:
         # adopt the service's recorder: admission-decision spans land on
@@ -533,12 +550,17 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
         # adopt the store: admission's per-model capacity gate queues a
         # burst on model A instead of letting it thrash model B's hot set
         admission.modelstore = modelstore
+    if admission is not None and hbm is not None \
+            and getattr(admission, "hbm", None) is None:
+        # adopt the arbiter: _capacity_ok_locked consults ONE honest
+        # headroom number instead of summing per-tenant estimates
+        admission.hbm = hbm
     resources = InferResources(manager, batching=batching,
                                batch_window_s=batch_window_s, metrics=metrics,
                                trace=trace,
                                generation_engines=generation_engines,
                                watchdog=watchdog, admission=admission,
-                               role=role, modelstore=modelstore)
+                               role=role, modelstore=modelstore, hbm=hbm)
     server = Server(address, executor or Executor(n_threads=4))
     server._infer_resources = resources  # for shutdown
     service = AsyncService(SERVICE_NAME, resources)

@@ -419,6 +419,11 @@ class _BaseReplicaSet:
                 host = [str(m) for m in getattr(resp, "host_models", ())]
                 out[addr] = {"queued_requests": int(resp.queued_requests),
                              "free_kv_pages": int(resp.free_kv_pages),
+                             # unified HBM economy (tpulab.hbm): the one
+                             # honest device-headroom gauge (0 = replica
+                             # serves without an arbiter)
+                             "free_hbm_bytes": int(
+                                 getattr(resp, "free_hbm_bytes", 0) or 0),
                              "role": role,
                              "resident_models": resident,
                              "host_models": host}

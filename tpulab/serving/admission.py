@@ -229,7 +229,8 @@ class AdmissionController:
     """
 
     def __init__(self, config: Optional[AdmissionConfig] = None,
-                 load=None, metrics=None, trace=None, modelstore=None):
+                 load=None, metrics=None, trace=None, modelstore=None,
+                 hbm=None):
         self.config = config or AdmissionConfig()
         self._load = load
         self._metrics = metrics
@@ -240,6 +241,13 @@ class AdmissionController:
         #: model QUEUES (never thrashes the hot working set); adopted by
         #: build_infer_service when a modelstore is served
         self.modelstore = modelstore
+        #: optional tpulab.hbm.HBMArbiter — the unified device-memory
+        #: economy.  Armed, _capacity_ok_locked consults the arbiter's
+        #: ONE headroom number (free + reclaimable-under-pressure bytes)
+        #: instead of summing the KV tier's and the modelstore's
+        #: optimistic per-tenant estimates; adopted by
+        #: build_infer_service when an arbiter is served
+        self.hbm = hbm
         cfg = self.config
         self._lock = threading.Lock()
         self._queue = DeficitRoundRobinQueue(quantum=cfg.drr_quantum)
@@ -282,8 +290,11 @@ class AdmissionController:
         over the model axis, so counting LOGICAL free pages is already
         the per-shard headroom — one free page is page_nbytes/M bytes
         free on every shard at once."""
+        arb = self.hbm
         ms = self.modelstore
-        if ms is not None and model:
+        if arb is None and ms is not None and model:
+            # pre-arbiter multi-model gate (one of the two per-tenant
+            # estimates the unified headroom replaces below)
             try:
                 if not ms.can_admit(model):
                     # multi-model serving: this model's weights cannot be
@@ -309,7 +320,20 @@ class AdmissionController:
                 page_size = int(getattr(eng, "page_size", 0)
                                 or getattr(pool, "page_size", 1))
                 free = int(pool.free_pages)
-                if free * max(1, page_size) < cost:
+                if arb is not None:
+                    # unified HBM economy (tpulab.hbm): ONE honest
+                    # headroom — free pool pages plus what the ledger has
+                    # free or pressure could reclaim from the OTHER
+                    # tenants (evictable cold models, measured scratch
+                    # never double-counted) — instead of summing the KV
+                    # tier's and the modelstore's optimistic estimates
+                    pn = max(1, int(getattr(pool, "page_nbytes", 0) or 1))
+                    extra = (max(0, int(arb.free_hbm_bytes))
+                             + int(arb.reclaimable_bytes(exclude="kv")))
+                    if ((free + extra // pn) * max(1, page_size)
+                            < cost):
+                        return False
+                elif free * max(1, page_size) < cost:
                     # tiered KV (tpulab.kvcache): pages the engine can
                     # DEMOTE to the host tier instead of dropping count as
                     # headroom — admission sees the effective (HBM + host)

@@ -773,6 +773,86 @@ class ModelStoreMetrics:
         self.host_bytes.set(mux.store.bytes_used)
 
 
+class HBMMetrics:
+    """Unified-HBM-economy telemetry (`_hbm_*`; tpulab.hbm): per-tenant
+    occupancy and claim-count gauges, the single headroom gauge, and the
+    pressure-protocol counters (pressure rounds, forced KV demotions,
+    forced model evictions, denials) — the view that says whether the
+    device-memory economy is trading bytes productively (demotions +
+    evictions, headroom near zero) or thrashing/denying (denials
+    climbing, pressure rounds without reclaims).  Counters/gauges
+    advance via :meth:`poll` over an
+    :class:`~tpulab.hbm.HBMArbiter`."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.capacity_bytes = Gauge(
+            f"{ns}_hbm_capacity_bytes",
+            "Device-HBM budget the arbiter trades within",
+            registry=self.registry)
+        self.headroom_bytes = Gauge(
+            f"{ns}_hbm_headroom_bytes",
+            "THE headroom number: capacity minus every tenant's ledger "
+            "claims (negative = over-committed discovery)",
+            registry=self.registry)
+        self.tenant_bytes = Gauge(
+            f"{ns}_hbm_tenant_bytes",
+            "Ledger bytes claimed per tenant (weights / kv / scratch)",
+            ["tenant"], registry=self.registry)
+        self.tenant_claims = Gauge(
+            f"{ns}_hbm_tenant_claims",
+            "Live ledger claims per tenant (models resident, pools, "
+            "measured jits)", ["tenant"], registry=self.registry)
+        self.pressure_events = Counter(
+            f"{ns}_hbm_pressure_events",
+            "Pressure rounds run (a request found no free headroom)",
+            registry=self.registry)
+        self.demotions = Counter(
+            f"{ns}_hbm_demotions",
+            "Pressure rounds where the KV tenant reclaimed (idle KV "
+            "demoted to the host tier, pool shrunk)",
+            registry=self.registry)
+        self.evictions = Counter(
+            f"{ns}_hbm_evictions",
+            "Pressure rounds where the weights tenant reclaimed (cold "
+            "unleased models swapped out)", registry=self.registry)
+        self.denials = Counter(
+            f"{ns}_hbm_denials",
+            "Requests denied (timeout or nothing reclaimable) — the "
+            "requester degraded to its static-budget behavior",
+            registry=self.registry)
+        self.grants = Counter(
+            f"{ns}_hbm_grants", "Requests granted ledger bytes",
+            registry=self.registry)
+        self._last: Dict[str, int] = {}
+
+    def _advance(self, counter, key: str, value: int) -> None:
+        delta = value - self._last.get(key, 0)
+        if delta > 0:
+            counter.inc(delta)
+        self._last[key] = value
+
+    def poll(self, arbiter) -> None:
+        """Sample an HBMArbiter (control-loop / poller hook)."""
+        self.capacity_bytes.set(arbiter.capacity_bytes)
+        self.headroom_bytes.set(arbiter.free_hbm_bytes)
+        led = arbiter.ledger
+        for tenant in led.tenants():
+            self.tenant_bytes.labels(tenant=tenant).set(
+                led.tenant_bytes(tenant))
+            self.tenant_claims.labels(tenant=tenant).set(
+                led.tenant_claims(tenant))
+        self._advance(self.pressure_events, "pe", arbiter.pressure_events)
+        self._advance(self.demotions, "dem", arbiter.demotions_forced)
+        self._advance(self.evictions, "ev", arbiter.evictions_forced)
+        self._advance(self.denials, "den", arbiter.denials)
+        self._advance(self.grants, "gr", arbiter.grants)
+
+
 class AdmissionMetrics:
     """Admission-control telemetry (`_admission_*`; serving/admission.py):
     admitted/rejected/shed counters keyed by tenant (and rejection
