@@ -879,6 +879,21 @@ def main() -> None:
     except Exception as e:
         print(f"# hbm arbiter row skipped: {e!r}", file=sys.stderr)
 
+    # observability overhead (docs/OBSERVABILITY.md "Flight recorder"):
+    # the standard paged workload with the flight recorder armed AND a
+    # debugz poller pulling live snapshots vs bare.  The claims tracked:
+    # tokens are bit-identical armed vs off (the recorder observes,
+    # never steers), tok/s overhead stays < 5%, and the per-request
+    # record-assembly p99 (ms) is the direct cost figure.
+    _phase("obs_overhead")
+    try:
+        from tpulab.obs import benchmark_obs_overhead
+        _record(obs_overhead=benchmark_obs_overhead(
+            n_requests=8 if degraded else 16,
+            steps=16 if degraded else 32))
+    except Exception as e:
+        print(f"# obs overhead row skipped: {e!r}", file=sys.stderr)
+
     # disaggregated prefill/decode (docs/SERVING.md "Replica roles"):
     # the same prefill-heavy trace served by one unified pool vs a
     # prefill replica shipping finished KV over the host tier's wire

@@ -636,7 +636,9 @@ def test_poll_load_reads_status_gauges():
         assert load[addr] == {"queued_requests": 0, "free_kv_pages": 0,
                               "free_hbm_bytes": 0,  # no arbiter served
                               "role": "unified",
-                              "resident_models": [], "host_models": []}
+                              "resident_models": [], "host_models": [],
+                              # no prefix cache on a dense engine
+                              "prefix_hits": 0, "prefix_lookups": 0}
         assert rs._load_hint == [0]
     finally:
         if rs is not None:

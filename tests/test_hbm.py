@@ -579,3 +579,54 @@ def test_pool_grow_shrink_tracked_bytes():
     assert pool.shrink(8) == 2                # page 0 always survives
     assert pool.n_pages == 1
     pool.close()
+
+
+# -- elastic pool under a mesh (PR 11 follow-up, closed as a contract) --------
+def test_arbiter_armed_batcher_rejects_mesh():
+    """An arbiter-armed (elastic) pool under a mesh has NO silent
+    corruption path: grow/shrink per-shard accounting is untested, so
+    construction rejects with a clear NotImplementedError (ROADMAP
+    item 3 is where per-axis claims land)."""
+    from tpulab.parallel import make_mesh
+    lm2 = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                  n_layers=1, d_ff=64)
+    arb = HBMArbiter(64 * PN, measure_scratch=False)
+    mesh = make_mesh({"model": 2}, jax.devices()[:2])
+    with pytest.raises(NotImplementedError, match="mesh"):
+        ContinuousBatcher(lm2, n_heads=2, n_layers=1, lanes=2,
+                          max_len=24, page_size=8, n_pages=4,
+                          compute_dtype=jnp.float32, hbm=arb, mesh=mesh)
+    # the arbiter saw no tenant registration / claims from the aborted
+    # construction (a half-registered tenant would wedge later arming)
+    assert arb.ledger.total_claimed == 0
+    assert arb.verify() == {}
+
+
+def test_mesh_pool_grow_shrink_accounting_without_arbiter():
+    """The pool-level grow/shrink ops themselves keep exact LOGICAL and
+    per-shard byte accounting under a mesh (the primitive the future
+    per-axis arbiter will build on): page ids stay stable, per-shard
+    bytes stay hbm_bytes/n_shards, and freed ids come off the top."""
+    from tpulab.parallel import make_mesh
+    mesh = make_mesh({"model": 2}, jax.devices()[:2])
+    pool = PagedKVPool(5, 8, 1, 2, 16, jnp.float32, mesh=mesh)
+    try:
+        pn0 = pool.page_nbytes
+        assert pool.hbm_bytes == 5 * pn0
+        assert pool.hbm_bytes_per_shard * pool.n_shards == pool.hbm_bytes
+        held = [pool.allocate_page() for _ in range(2)]
+        assert pool.grow(3) == 3
+        assert pool.n_pages == 8 and pool.page_nbytes == pn0
+        assert pool.hbm_bytes == 8 * pn0
+        assert pool.hbm_bytes_per_shard * pool.n_shards == pool.hbm_bytes
+        # new top ids are allocatable; the held ids were never remapped
+        top = {pool.allocate_page() for _ in range(pool.free_pages)}
+        assert set(range(5, 8)) <= top and not (top & set(held))
+        pool.release_pages(list(top))
+        assert pool.shrink(3) == 3  # the grown top is contiguously free
+        assert pool.n_pages == 5 and pool.hbm_bytes == 5 * pn0
+        assert pool.hbm_bytes_per_shard * pool.n_shards == pool.hbm_bytes
+        pool.release_pages(held)
+        assert pool.free_pages == 4  # page 0 stays reserved scratch
+    finally:
+        pool.close()

@@ -149,6 +149,35 @@ def test_gen_inference_pb2_schema_drift_and_roundtrip():
         free_hbm_bytes=-4096).SerializeToString()).free_hbm_bytes == -4096
     assert pb.StatusResponse().free_hbm_bytes == 0  # no arbiter served
 
+    # prefix-cache effectiveness gauges (tpulab.obs PR): lifetime
+    # counters riding Status, parsed per-replica by poll_load — the
+    # prefix-affinity-routing signal (ROADMAP item 1)
+    pf = pb.StatusResponse.FromString(pb.StatusResponse(
+        prefix_hits=7, prefix_lookups=9).SerializeToString())
+    assert pf.prefix_hits == 7 and pf.prefix_lookups == 9
+    assert pb.StatusResponse().prefix_hits == 0    # no prefix cache
+    assert pb.StatusResponse().prefix_lookups == 0
+
+    # debugz (tpulab.obs): the Debug unary RPC's request/response — the
+    # snapshot is one JSON document (schema tpulab/obs/debugz.py), the
+    # profiler fields round-trip, and zero-value defaults read as "no
+    # capture asked / no snapshot produced"
+    dbq = pb.DebugRequest.FromString(pb.DebugRequest(
+        model_name="llm", profile_ticks=4,
+        profile_dir="/tmp/prof").SerializeToString())
+    assert dbq.model_name == "llm" and dbq.profile_ticks == 4
+    assert dbq.profile_dir == "/tmp/prof"
+    assert pb.DebugRequest().profile_ticks == 0
+    assert pb.DebugRequest().model_name == ""
+    dbr = pb.DebugResponse(snapshot_json='{"engines": {}}',
+                           profile_dir="/tmp/p")
+    dbr.status.code = pb.SUCCESS
+    dbr = pb.DebugResponse.FromString(dbr.SerializeToString())
+    assert dbr.snapshot_json == '{"engines": {}}'
+    assert dbr.profile_dir == "/tmp/p" and dbr.status.code == pb.SUCCESS
+    assert pb.DebugResponse().snapshot_json == ""
+    assert pb.DebugResponse().profile_dir == ""
+
 
 # -- capture policy (stubbed attempts; no device needed) ----------------------
 def _bc(monkeypatch, recs):

@@ -4,6 +4,8 @@ import numpy as np
 
 from tpulab.utils.tracing import StageTimer, annotate
 
+REPO = __file__.rsplit("/tests/", 1)[0]
+
 
 def test_stage_timer_splits():
     import jax.numpy as jnp
@@ -337,3 +339,73 @@ def test_two_process_merged_trace(tmp_path):
         if proc.poll() is None:
             proc.kill()
         proc.wait(timeout=30)
+
+
+def test_chrome_trace_ring_counts_drops(tmp_path, caplog):
+    """The bounded ring must not discard its head SILENTLY: overflowing
+    events are counted, the count rides save()'s otherData, and the
+    first drop warns once (only once)."""
+    import json
+    import logging
+
+    from tpulab.utils.tracing import ChromeTraceRecorder
+    rec = ChromeTraceRecorder(max_events=4)
+    with caplog.at_level(logging.WARNING, logger="tpulab.tracing"):
+        for i in range(10):
+            rec.add_span(f"s{i}", 0.0, 0.001)
+    assert len(rec) == 4
+    assert rec.dropped_events == 6
+    warnings = [r for r in caplog.records
+                if "dropped" in r.getMessage()]
+    assert len(warnings) == 1  # warn ONCE, not per event
+    path = str(tmp_path / "ring.json")
+    rec.save(path)
+    doc = json.load(open(path))
+    assert doc["otherData"]["dropped_events"] == 6
+    # the survivors are the most recent window
+    assert [e["name"] for e in doc["traceEvents"]] == \
+        ["s6", "s7", "s8", "s9"]
+    # counters overflow through the same accounting
+    rec.add_counter("c", 0.0, v=1)
+    assert rec.dropped_events == 7
+
+
+def test_metrics_inventory_documented_and_disjoint():
+    """Drift guard: every collector class in utils/metrics.py exports
+    only families the docs/OBSERVABILITY.md inventory tables name
+    (counters documented with their exported `_total` suffix), and no
+    family name is owned by two collectors — the one-scrape-endpoint
+    contract (MultiRegistryCollector) depends on it."""
+    from prometheus_client import CollectorRegistry
+
+    import tpulab.utils.metrics as M
+
+    doc = open(f"{REPO}/docs/OBSERVABILITY.md").read()
+    collectors = (M.InferenceMetrics, M.ReplicaSetMetrics,
+                  M.GenerationMetrics, M.AdmissionMetrics,
+                  M.KVTierMetrics, M.ModelStoreMetrics, M.HBMMetrics,
+                  M.ChaosMetrics)
+    families = {}
+    for cls in collectors:
+        m = cls(registry=CollectorRegistry())
+        names = set()
+        for fam in m.registry.collect():
+            # a Counter family exports `name_total` samples; the docs
+            # (and PromQL users) see that name
+            names.add(fam.name + ("_total" if fam.type == "counter"
+                                  else ""))
+        assert names, f"{cls.__name__} exported no families"
+        families[cls.__name__] = names
+    for cls_name, names in families.items():
+        for n in sorted(names):
+            assert n in doc, (
+                f"{cls_name} family {n!r} is not in the "
+                "docs/OBSERVABILITY.md metric inventory — new metrics "
+                "must be documented (and renames must update the docs)")
+    owners = sorted(families)
+    for i, a in enumerate(owners):
+        for b in owners[i + 1:]:
+            shared = families[a] & families[b]
+            assert not shared, (
+                f"{a} and {b} both export {sorted(shared)} — collector "
+                "name-prefixes must stay pairwise disjoint")

@@ -133,6 +133,12 @@ def build_file() -> dp.FileDescriptorProto:
         # (weights + KV pages + compiled scratch).  0 = no arbiter;
         # negative = over-committed discovery (scratch measured late).
         field("free_hbm_bytes", 9, F.TYPE_INT64),
+        # prefix-cache effectiveness across the replica's paged engines
+        # (lifetime counters: hits / lookups = hits + misses) — sampled
+        # into per-replica router gauges by poll_load (ROADMAP item 1:
+        # prefix-affinity routing tunes against these)
+        field("prefix_hits", 10, F.TYPE_INT64),
+        field("prefix_lookups", 11, F.TYPE_INT64),
     ])
 
     fd.message_type.add(name="HealthRequest")
@@ -140,6 +146,23 @@ def build_file() -> dp.FileDescriptorProto:
     m.field.extend([
         field("live", 1, F.TYPE_BOOL),
         field("ready", 2, F.TYPE_BOOL),
+    ])
+
+    # debugz (tpulab.obs): live engine introspection.  The snapshot is
+    # one JSON document (schema: tpulab/obs/debugz.py) — a diagnostic
+    # surface whose shape tracks engine internals every PR stays out of
+    # the proto schema on purpose.
+    m = fd.message_type.add(name="DebugRequest")
+    m.field.extend([
+        field("model_name", 1, F.TYPE_STRING),
+        field("profile_ticks", 2, F.TYPE_INT32),
+        field("profile_dir", 3, F.TYPE_STRING),
+    ])
+    m = fd.message_type.add(name="DebugResponse")
+    m.field.extend([
+        field("status", 1, F.TYPE_MESSAGE, type_name="RequestStatus"),
+        field("snapshot_json", 2, F.TYPE_STRING),
+        field("profile_dir", 3, F.TYPE_STRING),
     ])
 
     m = fd.message_type.add(name="GenerateRequest")
@@ -288,6 +311,19 @@ def main() -> int:
         "dr = pb.GenerateResponse(final=True, kv_shipment=b'wire');"
         "dr = pb.GenerateResponse.FromString(dr.SerializeToString());"
         "assert dr.kv_shipment == b'wire';"
+        "pf = pb.StatusResponse(prefix_hits=7, prefix_lookups=9);"
+        "pf = pb.StatusResponse.FromString(pf.SerializeToString());"
+        "assert pf.prefix_hits == 7 and pf.prefix_lookups == 9;"
+        "assert pb.StatusResponse().prefix_hits == 0;"
+        "assert pb.StatusResponse().prefix_lookups == 0;"
+        "dbq = pb.DebugRequest(model_name='llm', profile_ticks=4,"
+        " profile_dir='/tmp/prof');"
+        "dbq = pb.DebugRequest.FromString(dbq.SerializeToString());"
+        "assert dbq.profile_ticks == 4 and dbq.model_name == 'llm';"
+        "dbr = pb.DebugResponse(snapshot_json='{}', profile_dir='/tmp/p');"
+        "dbr = pb.DebugResponse.FromString(dbr.SerializeToString());"
+        "assert dbr.snapshot_json == '{}' and dbr.profile_dir == '/tmp/p';"
+        "assert pb.DebugResponse().snapshot_json == '';"
         "rr = pb.GenerateRequest(prompt=[1, 2, 9], steps=8,"
         " resume_length=2);"
         "rr = pb.GenerateRequest.FromString(rr.SerializeToString());"

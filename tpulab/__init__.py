@@ -15,6 +15,9 @@ Layer map (mirrors reference trtlab/CMakeLists.txt:2-19 layering):
                      recompute-free preemption, spill-backed prefix cache)
     tpulab.rpc       async gRPC microservice framework
     tpulab.serving   admission control & QoS frontend (docs/SERVING.md)
+    tpulab.obs       flight recorder (tail-sampled per-request wide
+                     events) + debugz live introspection
+                     (docs/OBSERVABILITY.md)
     tpulab.models    model zoo (ResNet, MNIST, transformer) in pure JAX
     tpulab.ops       Pallas kernels + attention ops
     tpulab.parallel  mesh/sharding, DP dispatch, ring attention

@@ -41,7 +41,8 @@ class InferenceManager(_EngineManager):
               models=None, modelstore=None,
               model_hbm_budget: Optional[int] = None,
               model_host_budget: Optional[int] = None,
-              pinned_models=(), hbm=None) -> "InferenceManager":
+              pinned_models=(), hbm=None,
+              flight=None) -> "InferenceManager":
         """Expose registered models over the TRTIS-style gRPC service
         (reference manager.serve() -> BasicInferService).  ``batching=True``
         enables server-side dynamic batching across concurrent callers;
@@ -72,7 +73,12 @@ class InferenceManager(_EngineManager):
         memory economy: pass the same arbiter to the engines/modelstore
         that rent from it — the Status RPC then reports the single
         ``free_hbm_bytes`` headroom and an attached admission controller
-        adopts it (docs/PERFORMANCE.md "HBM economy")."""
+        adopts it (docs/PERFORMANCE.md "HBM economy").
+
+        ``flight=FlightRecorder()`` (tpulab.obs) arms per-request wide
+        events with tail-based retention, and the ``Debug`` RPC serves
+        the live engine snapshot + on-demand profiler captures
+        (docs/OBSERVABILITY.md "Flight recorder" / "Debugz")."""
         builders = {}
         if models:
             from tpulab.models.registry import build_model
@@ -108,7 +114,7 @@ class InferenceManager(_EngineManager):
             batch_window_s=batch_window_s, metrics=metrics, trace=trace,
             generation_engines=generation_engines, watchdog=watchdog,
             admission=admission, role=role, modelstore=modelstore,
-            hbm=hbm)
+            hbm=hbm, flight=flight)
         if wait:
             self._server.run()
         else:

@@ -202,6 +202,18 @@ class FaultSchedule:
         with self._lock:
             return self._fired.get(point, 0)
 
+    def fired_snapshot(self) -> Dict[str, int]:
+        """Copy of every point's activation count — diffed around a
+        request window by the flight recorder (tpulab.obs) to attribute
+        "a chaos rule fired while this request was in flight"."""
+        with self._lock:
+            return dict(self._fired)
+
+    def seen_snapshot(self) -> Dict[str, int]:
+        """Copy of every point's occurrence count (the debugz view)."""
+        with self._lock:
+            return dict(self._seen)
+
     # -- the injection-point entry ------------------------------------------
     def fire(self, point: str) -> Optional[str]:
         """Apply the first matching eligible rule.  Returns ``"drop"`` when
@@ -265,6 +277,13 @@ def arm(schedule: Optional[FaultSchedule]) -> None:
 
 def armed() -> Optional[FaultSchedule]:
     return _ARMED
+
+
+def fired_snapshot() -> Dict[str, int]:
+    """Per-point activation counts of the armed schedule ({} disarmed) —
+    the window-diff source for per-request chaos attribution."""
+    s = _ARMED
+    return {} if s is None else s.fired_snapshot()
 
 
 def set_observer(fn) -> None:

@@ -1051,13 +1051,14 @@ class _PagedRequest:
                  "chunk_t0", "chunk_start", "kv_handle", "export_digest",
                  "draft_pages", "draft_len", "spec_enabled", "spec_ewma",
                  "spec_drafted", "spec_accepted", "spec_probe_in",
-                 "spec_probing")
+                 "spec_probing", "tenant", "lane", "fl")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
                  sampling: Optional[SamplingParams] = None,
                  priority: int = 0, stop_tokens=None,
                  logprobs: bool = False, deadline: Optional[float] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 tenant: Optional[str] = None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.steps = steps
         self.future: Future = Future()
@@ -1102,6 +1103,14 @@ class _PagedRequest:
         self.spec_accepted = 0     # of those, emitted (accepted) ones
         # -- request-lifecycle telemetry (trace spans + latency metrics) ----
         self.trace_id = trace_id
+        #: admission tenant (flight-recorder / debugz attribution only —
+        #: the scheduler never reads it)
+        self.tenant = tenant
+        #: last lane this request occupied (-1 = never admitted)
+        self.lane = -1
+        #: flight-recorder per-request detail (None = recorder disarmed:
+        #: the scheduling hot path pays one None check per site)
+        self.fl: Optional[dict] = None
         self.t_submit = _time.perf_counter()
         self.t_prefill0: Optional[float] = None  # first prefill start
         self.t_first: Optional[float] = None     # first emitted token
@@ -1205,7 +1214,7 @@ class ContinuousBatcher:
                  draft_n_heads: Optional[int] = None,
                  draft_n_kv_heads: Optional[int] = None,
                  spec_accept_floor: float = 0.35,
-                 mesh=None, hbm=None):
+                 mesh=None, hbm=None, flight=None):
         import jax
         import jax.numpy as jnp
 
@@ -1271,6 +1280,21 @@ class ContinuousBatcher:
         # carry/state stays replicated.  mesh=None is bit-for-bit today's
         # single-device path.
         self.mesh = getattr(self.pool, "mesh", None)
+        if self.hbm is not None and self.mesh is not None:
+            # PR 11's named follow-up, closed as an explicit contract:
+            # the elastic pool's grow/shrink per-shard accounting is
+            # UNTESTED under a mesh (the ladder recompiles sharded
+            # programs per size and concat/slice re-infer the output
+            # sharding) — reject at construction rather than leave a
+            # silent corruption path.  ROADMAP item 3 (per-axis ledger)
+            # is where this lands properly.
+            if self._owns_pool:
+                self.pool.close()
+            raise NotImplementedError(
+                "HBM-arbiter-armed serving (elastic PagedKVPool) under a "
+                "mesh is not supported: grow/shrink per-shard accounting "
+                "is untested — serve the arbiter single-device, or the "
+                "mesh without an arbiter (hbm=None)")
         if self.mesh is not None:
             from tpulab.parallel.sharding import (replicate,
                                                   transformer_param_shardings)
@@ -1462,6 +1486,18 @@ class ContinuousBatcher:
         #: inter-token / queue-wait / e2e distributions observed per
         #: completed request at the source, not polled
         self.metrics = metrics
+        #: optional tpulab.obs.FlightRecorder — per-request wide events
+        #: (docs/OBSERVABILITY.md "Flight recorder").  Armed, each request
+        #: carries a small detail dict (block sizes, ITL samples, swap
+        #: events, peak pages) and completion attaches the summary to the
+        #: future as ``_tpulab_flight``; requests whose wide event the RPC
+        #: layer assembles (flight_owner="rpc") are never double-recorded.
+        #: None = disarmed: one None check per site, tokens unchanged
+        #: either way (the recorder observes, never steers).
+        self.flight = flight
+        #: debugz on-demand XLA profiler capture (arm_profile): dict with
+        #: remaining/dir/active, managed by the scheduler thread only
+        self._profile: Optional[Dict[str, Any]] = None
         self._queue: List[_PagedRequest] = []
         self._requests: Dict[Future, _PagedRequest] = {}
         self._active: List[Optional[_PagedRequest]] = [None] * lanes
@@ -1526,7 +1562,9 @@ class ContinuousBatcher:
                priority: int = 0, stop_tokens=None,
                logprobs: bool = False, deadline=None,
                trace_id: Optional[str] = None,
-               export_digest: Optional[bytes] = None) -> Future:
+               export_digest: Optional[bytes] = None,
+               tenant: Optional[str] = None,
+               flight_owner: Optional[str] = None) -> Future:
         """``on_token(token, index)`` (optional) streams tokens as they
         decode — the hook the Generate RPC rides for paged serving.
         ``sampling`` selects the token policy (default greedy).
@@ -1555,7 +1593,12 @@ class ContinuousBatcher:
         (tpulab.disagg): submit with ``steps=1`` and the resulting
         snapshot covers exactly the prompt; the export
         :class:`~tpulab.kvcache.offload.SwapHandle` lands on the future
-        as ``_tpulab_kv_export`` (None when the swap degraded)."""
+        as ``_tpulab_kv_export`` (None when the swap degraded).
+        ``tenant`` tags the request for flight-recorder / debugz
+        attribution (never read by the scheduler); ``flight_owner="rpc"``
+        marks the wide event as assembled by the RPC layer — the engine
+        still attaches its completion summary to the future
+        (``_tpulab_flight``) but does not record it itself."""
         flat = np.asarray(prompt).reshape(-1)
         if isinstance(deadline, Deadline):
             deadline = deadline.expiry
@@ -1577,8 +1620,11 @@ class ContinuousBatcher:
         req = _PagedRequest(prompt, steps, on_token=on_token,
                             sampling=sampling, priority=priority,
                             stop_tokens=stop_tokens, logprobs=logprobs,
-                            deadline=deadline, trace_id=trace_id)
+                            deadline=deadline, trace_id=trace_id,
+                            tenant=tenant)
         req.export_digest = export_digest
+        if self.flight is not None or flight_owner:
+            self._fl_arm(req, flight_owner)
         with self._cv:
             if self._shutdown:
                 raise RuntimeError("ContinuousBatcher is shut down")
@@ -1591,7 +1637,9 @@ class ContinuousBatcher:
                        handle, on_token=None,
                        sampling: Optional[SamplingParams] = None,
                        priority: int = 0, stop_tokens=None, deadline=None,
-                       trace_id: Optional[str] = None) -> Future:
+                       trace_id: Optional[str] = None,
+                       tenant: Optional[str] = None,
+                       flight_owner: Optional[str] = None) -> Future:
         """Admit a request whose prompt KV arrived SHIPPED from a prefill
         replica (tpulab.disagg) — the decode-replica half of
         disaggregated serving.
@@ -1643,7 +1691,9 @@ class ContinuousBatcher:
         req = _PagedRequest(prompt, steps, on_token=on_token,
                             sampling=sp, priority=priority,
                             stop_tokens=stop_tokens, deadline=deadline,
-                            trace_id=trace_id)
+                            trace_id=trace_id, tenant=tenant)
+        if self.flight is not None or flight_owner:
+            self._fl_arm(req, flight_owner)
         # the first-token pick already happened on the prefill replica:
         # seed the lane as a resume (a degraded restore then re-prefills
         # and DISCARDS its logits, exactly like a preemption resume)
@@ -1656,6 +1706,7 @@ class ContinuousBatcher:
             if handle is not None and self.kv_offload is not None:
                 self.kv_offload.discard(handle)
             req.kv_handle = None
+            self._flight_complete(req)
             req.future.set_result(self._result_of(req))
             self.completed_requests += 1
             return req.future
@@ -1747,6 +1798,222 @@ class ContinuousBatcher:
     def _note_complete(self, req: _PagedRequest) -> None:
         if self.metrics is not None:
             self.metrics.observe_e2e(_time.perf_counter() - req.t_submit)
+
+    # -- flight recorder (tpulab.obs, docs/OBSERVABILITY.md) ----------------
+    #: per-request detail lists stay bounded — a pathological request
+    #: must not turn its own wide event into a memory leak
+    FLIGHT_DETAIL_CAP = 1024
+
+    @staticmethod
+    def _fl_arm(req: _PagedRequest, owner: Optional[str]) -> None:
+        """Attach the per-request flight detail dict (armed path only)."""
+        req.fl = {"owner": owner, "blocks": [], "itl": [],
+                  "swap_outs": 0, "swap_ins": 0, "preempts": 0,
+                  "pages_peak": 0, "chaos0": chaos.fired_snapshot()}
+
+    def _fl_block(self, req: _PagedRequest, k: int, n: int,
+                  dt: Optional[float]) -> None:
+        """One fused-decode dispatch's contribution to the wide event:
+        block size K, tokens emitted, the spread per-token latency."""
+        fl = req.fl
+        if fl is None:
+            return
+        if len(fl["blocks"]) < self.FLIGHT_DETAIL_CAP:
+            fl["blocks"].append((k, n))
+        if dt is not None and len(fl["itl"]) < self.FLIGHT_DETAIL_CAP:
+            fl["itl"].append((dt, n))
+        pages = len(req.pages) + len(req.draft_pages)
+        if pages > fl["pages_peak"]:
+            fl["pages_peak"] = pages
+
+    def _fl_pages(self, req: _PagedRequest) -> None:
+        fl = req.fl
+        if fl is not None:
+            pages = len(req.pages) + len(req.draft_pages)
+            if pages > fl["pages_peak"]:
+                fl["pages_peak"] = pages
+
+    def _flight_summary(self, req: _PagedRequest,
+                        outcome: str) -> Dict[str, Any]:
+        """The engine's half of the wide event (the RPC layer adds
+        admission/status/transport fields for requests it owns)."""
+        now = _time.perf_counter()
+        ev: Dict[str, Any] = {
+            "kind": "paged", "outcome": outcome, "tenant": req.tenant,
+            "priority": req.priority, "trace_id": req.trace_id,
+            "prompt_tokens": int(len(req.prompt)), "steps": req.steps,
+            "tokens": len(req.tokens_out),
+            "t_submit": req.t_submit, "t_prefill0": req.t_prefill0,
+            "t_first": req.t_first, "t_last": req.t_last,
+            "e2e_s": now - req.t_submit, "lane": req.lane,
+            "pages": len(req.pages),
+        }
+        if req.t_prefill0 is not None:
+            ev["queue_wait_s"] = req.t_prefill0 - req.t_submit
+        if req.t_first is not None:
+            ev["ttft_s"] = req.t_first - req.t_submit
+        if req.spec_drafted:
+            ev["spec_drafted"] = req.spec_drafted
+            ev["spec_accepted"] = req.spec_accepted
+            ev["spec_acceptance"] = round(
+                req.spec_accepted / req.spec_drafted, 4)
+        fl = req.fl
+        if fl is not None:
+            ev["pages_peak"] = max(fl["pages_peak"], len(req.pages))
+            ev["block_ks"] = [k for k, _n in fl["blocks"]]
+            ev["preempts"] = fl["preempts"]
+            ev["swap_outs"] = fl["swap_outs"]
+            ev["swap_ins"] = fl["swap_ins"]
+            if fl["itl"]:
+                itl = np.repeat([d for d, _ in fl["itl"]],
+                                [n for _, n in fl["itl"]])
+                ev["itl_ms"] = {
+                    "p50": round(float(np.percentile(itl, 50)) * 1e3, 4),
+                    "p99": round(float(np.percentile(itl, 99)) * 1e3, 4),
+                    "max": round(float(itl.max()) * 1e3, 4),
+                    "n": int(itl.size)}
+            trips = {}
+            for point, n in chaos.fired_snapshot().items():
+                d = n - fl["chaos0"].get(point, 0)
+                if d > 0:
+                    trips[point] = d
+            if trips:
+                ev["chaos_trips"] = trips
+        if self.hbm is not None:
+            ev["hbm_pressure_events"] = self.hbm.pressure_events
+        return ev
+
+    def _flight_complete(self, req: _PagedRequest,
+                         outcome: str = "SUCCESS") -> None:
+        """Completion hook (every future-resolution site): attach the
+        engine summary to the future BEFORE it resolves (race-free, the
+        ``_tpulab_compute_s`` idiom) and record it — unless the RPC layer
+        owns this request's wide event."""
+        fr = self.flight
+        if fr is None and req.fl is None:
+            return
+        ev = self._flight_summary(req, outcome)
+        req.future._tpulab_flight = ev
+        owner = req.fl.get("owner") if req.fl is not None else None
+        if fr is not None and owner != "rpc":
+            fr.observe(ev)
+
+    # -- debugz (tpulab.obs.debugz) -----------------------------------------
+    def arm_profile(self, ticks: int, log_dir: Optional[str] = None) -> str:
+        """Arm ``jax.profiler`` around the next ``ticks`` scheduler ticks
+        (the Debug RPC's ``profile_ticks``).  The capture starts at the
+        next pass the scheduler runs and stops after ``ticks`` passes;
+        returns the trace directory (``tensorboard --logdir`` it)."""
+        if int(ticks) < 1:
+            raise ValueError("profile_ticks must be >= 1")
+        if log_dir is None:
+            import tempfile
+            log_dir = tempfile.mkdtemp(prefix="tpulab-profile-")
+        with self._cv:
+            if self._profile is not None:
+                raise RuntimeError("a profiler capture is already armed")
+            self._profile = {"remaining": int(ticks), "dir": log_dir,
+                             "active": False}
+            self._cv.notify()
+        return log_dir
+
+    def _profile_step(self, done: bool = False) -> None:
+        """Scheduler-thread profiler bookkeeping: start the armed capture,
+        count one pass, stop at zero (or at shutdown with ``done``)."""
+        prof = self._profile
+        if prof is None:
+            return
+        import jax
+        if done:
+            if prof["active"]:
+                jax.profiler.stop_trace()
+            self._profile = None
+            return
+        if not prof["active"]:
+            jax.profiler.start_trace(prof["dir"])
+            prof["active"] = True
+            return  # the NEXT ticks are captured; arming pass is free
+        prof["remaining"] -= 1
+        if prof["remaining"] <= 0:
+            jax.profiler.stop_trace()
+            self._profile = None
+
+    def debug_state(self) -> Dict[str, Any]:
+        """Live scheduler introspection for debugz (one consistent
+        snapshot under the scheduler lock): lanes, queue, elastic pool +
+        ladder position, dispatch counters, speculative and prefix-cache
+        state."""
+        now = _time.perf_counter()
+        with self._cv:
+            lanes = []
+            for lane, req in enumerate(self._active):
+                if req is None:
+                    lanes.append({"lane": lane, "state": "idle"})
+                    continue
+                lanes.append({
+                    "lane": lane,
+                    "state": ("prefill" if req.pending_prompt
+                              else "decode"),
+                    "tenant": req.tenant, "priority": req.priority,
+                    "trace_id": req.trace_id,
+                    "age_s": round(now - req.t_submit, 6),
+                    "tokens": len(req.tokens_out), "steps": req.steps,
+                    "prompt_tokens": int(len(req.prompt)),
+                    "pages": len(req.pages),
+                    "draft_pages": len(req.draft_pages),
+                    "cancelled": req.cancelled,
+                })
+            queue_head = [{"tenant": q.tenant, "priority": q.priority,
+                           "age_s": round(now - q.t_submit, 6),
+                           "prompt_tokens": int(len(q.prompt)),
+                           "steps": q.steps}
+                          for q in self._queue[:16]]
+            queued = len(self._queue)
+            profile_armed = self._profile is not None
+        pool = self.pool
+        rung, size = 0, self._hbm_pool_base
+        while size and size * 2 <= pool.n_pages:
+            size *= 2
+            rung += 1
+        out: Dict[str, Any] = {
+            "kind": "paged",
+            "lanes": lanes,
+            "queued_requests": queued,
+            "queue_head": queue_head,
+            "pool": {"n_pages": pool.n_pages,
+                     "free_pages": pool.free_pages,
+                     "page_size": pool.page_size,
+                     "page_nbytes": pool.page_nbytes,
+                     "hbm_bytes": pool.hbm_bytes,
+                     "n_shards": pool.n_shards,
+                     "elastic": self.hbm is not None,
+                     "ladder_base": self._hbm_pool_base,
+                     "ladder_rung": rung,
+                     "grows": self.hbm_grows,
+                     "shrinks": self.hbm_shrinks},
+            "dispatch": {"decode_block": self.decode_block,
+                         "decode_dispatches": self.decode_dispatches,
+                         "decode_host_syncs": self.decode_host_syncs,
+                         "prefill_dispatches": self.prefill_dispatches,
+                         "preemptions": self.preemptions,
+                         "completed_requests": self.completed_requests,
+                         "tokens_generated": self.tokens_generated},
+            "profile_armed": profile_armed,
+        }
+        if self._spec is not None:
+            out["spec"] = {"dispatches": self.spec_dispatches,
+                           "fallbacks": self.spec_fallbacks,
+                           "tokens_drafted": self.spec_tokens_drafted,
+                           "tokens_accepted": self.spec_tokens_accepted,
+                           "acceptance": round(self.spec_acceptance, 4),
+                           "probes": self.spec_probes,
+                           "probe_recoveries": self.spec_probe_recoveries}
+        pc = self.prefix_cache
+        if pc is not None:
+            out["prefix_cache"] = {"entries": len(pc), "hits": pc.hits,
+                                   "misses": pc.misses,
+                                   "host_promotions": pc.host_promotions}
+        return out
 
     # -- scheduler ----------------------------------------------------------
     def _enqueue_locked(self, req: _PagedRequest,
@@ -1988,6 +2255,7 @@ class ContinuousBatcher:
         req.pages.append(page)
         req.admit_seq = self._admit_counter
         self._admit_counter += 1
+        req.lane = lane
         self._active[lane] = req
         return True
 
@@ -2043,12 +2311,17 @@ class ContinuousBatcher:
         them back in with zero prefill dispatches, and the re-prefill
         below becomes the FALLBACK for a failed/dropped swap."""
         req = self._active[lane]
+        self._fl_pages(req)
+        if req.fl is not None:
+            req.fl["preempts"] += 1
         if self.kv_offload is not None and req.length > 0:
             t_sw0 = _time.perf_counter()
             needed = (req.length + self.page_size - 1) // self.page_size
             req.kv_handle = self.kv_offload.swap_out(
                 req.pages[:needed], req.length, self.pool.kv)
             if req.kv_handle is not None:
+                if req.fl is not None:
+                    req.fl["swap_outs"] += 1
                 self._span("swap_out", lane, t_sw0,
                            _time.perf_counter() - t_sw0, req,
                            pages=needed, tokens=req.length)
@@ -2083,6 +2356,7 @@ class ContinuousBatcher:
                        and not self._hbm_reclaim_bytes):
                     self._cv.wait()
                 if self._shutdown and not self._queue and not any(self._active):
+                    self._profile_step(done=True)  # close an open capture
                     return
                 # HBM arbiter pressure: serve an outstanding reclaim at
                 # the tick boundary (no dispatched block is in flight
@@ -2118,13 +2392,16 @@ class ContinuousBatcher:
                     self._queue[:] = still
                 self._admit_locked()
                 snapshot = list(self._active)
+            self._profile_step()  # debugz on-demand capture bookkeeping
             for req in swept:
+                self._flight_complete(req, "CANCELLED")
                 if not req.future.done():
                     req.future.cancel() or req.future.set_exception(
                         RuntimeError("generation cancelled"))
             for req in expired:
                 if self.metrics is not None:
                     self.metrics.note_deadline_expired()
+                self._flight_complete(req, "DEADLINE_EXCEEDED")
                 if not req.future.done():
                     req.future.set_exception(DeadlineExceeded(
                         "generation deadline exceeded "
@@ -2147,6 +2424,7 @@ class ContinuousBatcher:
                         snapshot = list(self._active)
                     for req in done_reqs:
                         if not req.future.done():
+                            self._flight_complete(req)
                             req.future.set_result(self._result_of(req))
                             self.completed_requests += 1
                             self._note_complete(req)
@@ -2185,6 +2463,7 @@ class ContinuousBatcher:
                     for lane, req in enumerate(self._active):
                         if req is not None:
                             if not req.future.done():
+                                self._flight_complete(req, "INTERNAL")
                                 req.future.set_exception(e)
                             self._requests.pop(req.future, None)
                             self._active[lane] = None
@@ -2295,6 +2574,7 @@ class ContinuousBatcher:
                 start += m
         req.length = t
         req.pending_prompt = []
+        self._fl_pages(req)
         was_resumed = req.resumed
         if was_resumed:
             # preemption resume: the fed tail ends at tokens_out[-2]; the
@@ -2387,6 +2667,9 @@ class ContinuousBatcher:
         req.pending_prompt = []
         req.resumed = False  # the first-token pick happened pre-preemption
         now = _time.perf_counter()
+        if req.fl is not None:
+            req.fl["swap_ins"] += 1
+        self._fl_pages(req)
         self._span("swap_in", lane, t0, now - t0, req,
                    pages=needed, tokens=t)
         req.chunk_t0 = now        # decode chunks restart here
@@ -2819,6 +3102,7 @@ class ContinuousBatcher:
                         req.logprobs_out.append(lp)
                     emits.append((req, tok, len(req.tokens_out) - 1, lp))
                 req.t_last = now
+                self._fl_block(req, k, n, dt)
                 self._flush_decode_chunk(req, lane, now, block=k)
                 if req.finished():
                     self._release_lane_locked(lane, req)
@@ -2859,6 +3143,7 @@ class ContinuousBatcher:
             self._emit(req, tok, i, lp)
         for req in completed:
             if not req.future.done():
+                self._flight_complete(req)
                 req.future.set_result(self._result_of(req))
                 self.completed_requests += 1
                 self._note_complete(req)
@@ -3040,6 +3325,7 @@ class ContinuousBatcher:
                     # the block's own draft writes cover every accepted
                     # position (k+1 scan iterations: no holes)
                     req.draft_len = req.length
+                self._fl_block(req, k, n, dt)
                 self._flush_decode_chunk(req, lane, now, block=k,
                                          accepted=a)
                 if req.finished():
@@ -3055,6 +3341,7 @@ class ContinuousBatcher:
             self._emit(req, tok, i, lp)
         for req in completed:
             if not req.future.done():
+                self._flight_complete(req)
                 req.future.set_result(self._result_of(req))
                 self.completed_requests += 1
                 self._note_complete(req)
@@ -3157,6 +3444,9 @@ class ContinuousBatcher:
                 self.tokens_generated += 1
                 if self.metrics is not None and req.t_last is not None:
                     self.metrics.observe_itl(now - req.t_last)
+                self._fl_block(req, 1, 1,
+                               (now - req.t_last)
+                               if req.t_last is not None else None)
                 req.t_last = now
                 lp = (float(logprobs_arr[lane])
                       if logprobs_arr is not None else None)
@@ -3178,6 +3468,7 @@ class ContinuousBatcher:
             self._emit(req, tok, i, lp)
         for req in completed:
             if not req.future.done():
+                self._flight_complete(req)
                 req.future.set_result(self._result_of(req))
                 self.completed_requests += 1
                 self._note_complete(req)

@@ -180,6 +180,14 @@ class HBMArbiter:
                     pass
         return out
 
+    def reservations(self) -> List[Dict[str, Any]]:
+        """Live anti-steal reservations: bytes freed under pressure that
+        are being held for blocked requesters (the debugz view of
+        ``_waiting`` — empty in steady state)."""
+        with self.ledger._cv:
+            return [{"tenant": t, "bytes": int(n)}
+                    for t, n in self._waiting.values()]
+
     def verify(self) -> Dict[str, Any]:
         """Ledger-vs-gauges cross-check (empty dict = consistent)."""
         return self.ledger.verify(self.gauges())

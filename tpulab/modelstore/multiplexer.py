@@ -376,6 +376,14 @@ class WeightMultiplexer:
         with self._lock:
             return self._entries[name].state
 
+    def lease_counts(self) -> Dict[str, Dict[str, Any]]:
+        """Per-model residency + lease refcounts + pins (the debugz live
+        view): ``{name: {"state", "refs", "pinned", "bytes"}}``."""
+        with self._lock:
+            return {n: {"state": e.state, "refs": int(e.refs),
+                        "pinned": bool(e.pinned), "bytes": int(e.nbytes)}
+                    for n, e in self._entries.items()}
+
     # -- admission signal ----------------------------------------------------
     def can_admit(self, name: str) -> bool:
         """Could ``name`` be made resident without touching any leased /

@@ -1,0 +1,25 @@
+"""tpulab.obs — per-request wide events + live engine introspection.
+
+The two views aggregate telemetry (PR 2's metrics/traces) cannot give:
+
+- :class:`FlightRecorder` (flight.py): ONE structured wide event per
+  request, tail-sampled — errors, deadline/overload outcomes, stalls,
+  chaos-hit requests and the rolling slowest-p99 exemplars always
+  survive the bounded ring; healthy traffic is uniformly sampled.
+  Answers "why was THIS request slow" from the record, not a regex over
+  logs.
+- :func:`debug_snapshot` (debugz.py): the live "what is the engine
+  holding right now" document — lanes, elastic pool ladder position,
+  HBM ledger claims + verify, modelstore leases, admission queue depths,
+  chaos armament, flight exemplar pointers — served over the ``Debug``
+  RPC with on-demand XLA profiler capture.
+
+See docs/OBSERVABILITY.md ("Flight recorder", "Debugz").
+"""
+
+from tpulab.obs.bench import benchmark_obs_overhead  # noqa: F401
+from tpulab.obs.debugz import arm_profile, debug_snapshot  # noqa: F401
+from tpulab.obs.flight import KEEP_REASONS, FlightRecorder  # noqa: F401
+
+__all__ = ["FlightRecorder", "KEEP_REASONS", "debug_snapshot",
+           "arm_profile", "benchmark_obs_overhead"]

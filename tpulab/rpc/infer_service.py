@@ -64,9 +64,15 @@ class InferResources(Resources):
                  batch_window_s: float = 0.002, metrics=None,
                  generation_engines: Optional[Dict[str, object]] = None,
                  watchdog=None, trace=None, admission=None,
-                 role: str = "unified", modelstore=None, hbm=None):
+                 role: str = "unified", modelstore=None, hbm=None,
+                 flight=None):
         self.manager = manager
         self.metrics = metrics
+        #: optional tpulab.obs.FlightRecorder — one tail-sampled wide
+        #: event per request, assembled here at completion from the
+        #: serving-path hooks (docs/OBSERVABILITY.md "Flight recorder").
+        #: None = disarmed: one is-None branch per request.
+        self.flight = flight
         #: optional tpulab.hbm.HBMArbiter — the unified device-memory
         #: economy.  Status reports its single headroom number
         #: (free_hbm_bytes) so routers and admission see ONE honest
@@ -199,6 +205,7 @@ class StatusContext(Context):
         if res.admission is not None:
             queued += res.admission.queue_depth
         free_pages = 0
+        prefix_hits = prefix_lookups = 0
         for eng in res.generation_engines.values():
             queued += int(getattr(eng, "queued_requests", 0) or 0)
             pool = getattr(eng, "pool", None)
@@ -207,8 +214,20 @@ class StatusContext(Context):
                     free_pages += int(pool.free_pages)
                 except Exception:  # torn-down pool: report what we can
                     pass
+            pc = getattr(eng, "prefix_cache", None)
+            if pc is not None:
+                # prefix-cache effectiveness (lifetime counters): the
+                # per-replica gauge prefix-affinity routing needs
+                # (ROADMAP item 1) — lookups = hits + misses
+                try:
+                    prefix_hits += int(pc.hits)
+                    prefix_lookups += int(pc.hits) + int(pc.misses)
+                except Exception:  # torn-down cache: report what we can
+                    pass
         resp.queued_requests = queued
         resp.free_kv_pages = free_pages
+        resp.prefix_hits = prefix_hits
+        resp.prefix_lookups = prefix_lookups
         resp.role = res.role
         if res.hbm is not None:
             # unified HBM economy (tpulab.hbm): ONE honest headroom
@@ -254,9 +273,25 @@ class InferContext(Context):
         res0 = self.get_resources(InferResources)
         res0.request_started()
         try:
-            return self._execute(request)
+            resp = self._execute(request)
         finally:
             res0.request_finished()
+        if res0.flight is not None:
+            # unary wide event (lighter than generation's: no phases —
+            # the stage profile already covers the dense pipeline)
+            from tpulab.serving.admission import tenant_of_request
+            tc = TraceContext.of_request(request, self.grpc_context)
+            try:
+                outcome = pb.StatusCode.Name(resp.status.code)
+            except ValueError:  # pragma: no cover - unknown code
+                outcome = str(resp.status.code)
+            res0.flight.observe({
+                "kind": "infer", "model": request.model_name,
+                "tenant": tenant_of_request(request, self.grpc_context),
+                "trace_id": tc.trace_id if tc is not None else None,
+                "batch": max(1, int(request.batch_size)),
+                "outcome": outcome, "e2e_s": self.walltime()})
+        return resp
 
     def _execute(self, request: pb.InferRequest) -> pb.InferResponse:
         mgr = self.get_resources(InferResources).manager
@@ -411,6 +446,59 @@ class HealthContext(Context):
         return pb.HealthResponse(live=True, ready=ready)
 
 
+class DebugContext(Context):
+    """Debugz unary RPC (tpulab.obs, docs/OBSERVABILITY.md "Debugz"):
+    the live "what is the engine holding RIGHT NOW" snapshot — lanes,
+    elastic pool ladder position, HBM ledger claims + verify,
+    modelstore leases, per-tenant admission queue depths, chaos
+    armament, flight-recorder exemplar pointers — as one JSON document
+    (``snapshot_json``; schema: tpulab/obs/debugz.py).
+    ``profile_ticks=N`` additionally arms ``jax.profiler`` around the
+    next N scheduler ticks of the selected engine and returns the trace
+    directory."""
+
+    def execute_rpc(self, request: pb.DebugRequest) -> pb.DebugResponse:
+        import json as _json
+        res = self.get_resources(InferResources)
+        resp = pb.DebugResponse()
+        name = request.model_name
+        if name and name not in res.generation_engines:
+            resp.status.code = pb.UNKNOWN_MODEL
+            resp.status.message = f"no generation engine for {name!r}"
+            return resp
+        if request.profile_ticks:
+            from tpulab.obs.debugz import arm_profile
+            try:
+                resp.profile_dir = arm_profile(
+                    res.generation_engines, name,
+                    int(request.profile_ticks),
+                    request.profile_dir or "")
+            except KeyError:
+                resp.status.code = pb.INVALID_ARGUMENT
+                resp.status.message = ("profile_ticks needs a profile-"
+                                       "capable (paged) generation engine")
+                return resp
+            except (RuntimeError, ValueError) as e:
+                # a capture already armed / bad tick count: report it,
+                # still return the snapshot (the operator asked to LOOK)
+                resp.status.message = f"profiler not armed: {e}"
+        from tpulab.obs.debugz import debug_snapshot
+        try:
+            snap = debug_snapshot(res, model_name=name)
+            snap["server_version"] = SERVER_VERSION
+            snap["role"] = res.role
+            snap["draining"] = res.draining
+            snap["inflight_requests"] = res.inflight_requests
+            snap["stage_profile"] = res.stage_profile()
+            resp.snapshot_json = _json.dumps(snap, default=str)
+            resp.status.code = pb.SUCCESS
+        except Exception as e:  # noqa: BLE001 - debugz must not crash
+            log.exception("debug snapshot failed")
+            resp.status.code = pb.INTERNAL
+            resp.status.message = str(e)
+        return resp
+
+
 class StreamInferContext(StreamingContext):
     """Bidirectional pipelined inference (reference TRTIS StreamInfer /
     nvrpc streaming contexts): each incoming InferRequest dispatches
@@ -517,7 +605,7 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                         generation_engines: Optional[Dict[str, object]] = None,
                         watchdog=None, trace=None, admission=None,
                         role: str = "unified", modelstore=None,
-                        hbm=None) -> Server:
+                        hbm=None, flight=None) -> Server:
     """Wire the inference service onto a Server
     (reference BasicInferService ctor infer.cc:644-678).
 
@@ -539,7 +627,11 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     the unified device-memory economy — Status reports its single
     ``free_hbm_bytes`` headroom and an attached admission controller
     adopts it for capacity decisions (docs/PERFORMANCE.md "HBM
-    economy")."""
+    economy").  ``flight`` is an optional
+    :class:`tpulab.obs.FlightRecorder`: every request assembles one
+    tail-sampled wide event at completion, and the ``Debug`` RPC's
+    snapshot points at the retained exemplars (docs/OBSERVABILITY.md
+    "Flight recorder")."""
     if admission is not None and trace is not None \
             and getattr(admission, "trace", None) is None:
         # adopt the service's recorder: admission-decision spans land on
@@ -560,7 +652,8 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                                trace=trace,
                                generation_engines=generation_engines,
                                watchdog=watchdog, admission=admission,
-                               role=role, modelstore=modelstore, hbm=hbm)
+                               role=role, modelstore=modelstore, hbm=hbm,
+                               flight=flight)
     server = Server(address, executor or Executor(n_threads=4))
     server._infer_resources = resources  # for shutdown
     service = AsyncService(SERVICE_NAME, resources)
@@ -573,6 +666,9 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     service.register_rpc("Health", HealthContext,
                          pb.HealthRequest.FromString,
                          pb.HealthResponse.SerializeToString)
+    service.register_rpc("Debug", DebugContext,
+                         pb.DebugRequest.FromString,
+                         pb.DebugResponse.SerializeToString)
     service.register_rpc("StreamInfer", StreamInferContext,
                          pb.InferRequest.FromString,
                          pb.InferResponse.SerializeToString)
@@ -610,10 +706,115 @@ class GenerateContext(StreamingContext):
     def _run(self, request: pb.GenerateRequest) -> None:
         res = self.get_resources(InferResources)
         res.request_started()  # generation streams count toward drain
+        self._flight_begin(request, res)
         try:
             self._run_counted(request)
         finally:
             res.request_finished()
+            self._flight_finish(res)
+
+    # -- flight recorder (tpulab.obs): the wide-event assembly --------------
+    def _flight_begin(self, request: pb.GenerateRequest,
+                      res: InferResources) -> None:
+        """Arm this stream's wide event: capture identity and the
+        start-of-window counters NOW, and intercept writes so the final
+        status (and delivered-token count) land in the record without
+        touching any engine path.  Disarmed cost: one is-None branch."""
+        if res.flight is None:
+            self._fl_ev = None
+            return
+        import time as _time
+        from tpulab.serving.admission import tenant_of_request
+        tc = TraceContext.of_request(request, self.grpc_context)
+        ev: Dict[str, Any] = {
+            "kind": "generate", "model": request.model_name,
+            "tenant": tenant_of_request(request, self.grpc_context),
+            "priority": int(request.priority),
+            "trace_id": tc.trace_id if tc is not None else None,
+            "prompt_tokens": len(request.prompt),
+            "steps": int(request.steps),
+            "deadline_ms": int(request.deadline_ms) or None,
+            "t_submit": _time.perf_counter(),
+            "_chaos0": chaos.fired_snapshot(),
+            "_final": [], "_delivered": [0],
+        }
+        if request.resume_length:
+            ev["resume_length"] = int(request.resume_length)
+        if request.prefill_only:
+            ev["prefill_only"] = True
+        if res.hbm is not None:
+            ev["_hbm0"] = int(res.hbm.pressure_events)
+        final, delivered = ev["_final"], ev["_delivered"]
+        orig_write = self.write
+
+        def counting_write(resp, _orig=orig_write):
+            if getattr(resp, "final", False):
+                final.append(int(resp.status.code))
+            else:
+                delivered[0] += 1
+            _orig(resp)
+
+        # streaming contexts are per-stream (never pooled), so the
+        # wrapper lives and dies with this request
+        self.write = counting_write
+        self._fl_ev = ev
+
+    def _fl_note(self, **kw) -> None:
+        """Annotate the pending wide event (no-op disarmed)."""
+        ev = getattr(self, "_fl_ev", None)
+        if ev is not None:
+            ev.update(kw)
+
+    def _flight_finish(self, res: InferResources) -> None:
+        """Assemble + record the wide event at stream completion: merge
+        the engine's summary (``_tpulab_flight``), resolve the outcome
+        from the intercepted final status, and diff the chaos/HBM window
+        counters."""
+        ev = getattr(self, "_fl_ev", None)
+        if ev is None or res.flight is None:
+            return
+        self._fl_ev = None
+        import time as _time
+        final = ev.pop("_final")
+        delivered = ev.pop("_delivered")[0]
+        chaos0 = ev.pop("_chaos0")
+        hbm0 = ev.pop("_hbm0", None)
+        eng = ev.pop("_engine_ev", None)
+        if eng:
+            # engine summary first (lane/pages/blocks/ITL/spec/swaps);
+            # the RPC layer's identity + window fields override
+            merged = dict(eng)
+            merged.update({k: v for k, v in ev.items() if v is not None})
+            ev = merged
+        ev["tokens_delivered"] = delivered
+        ev["e2e_s"] = _time.perf_counter() - ev["t_submit"]
+        if final:
+            try:
+                ev["outcome"] = pb.StatusCode.Name(final[-1])
+            except ValueError:  # pragma: no cover - unknown code
+                ev["outcome"] = str(final[-1])
+        elif ev.get("stalled"):
+            ev["outcome"] = "STALLED"
+        elif eng and eng.get("outcome") not in (None, "SUCCESS"):
+            ev["outcome"] = eng["outcome"]  # e.g. engine-side CANCELLED
+        else:
+            # no final ever went out and nothing stalled: the client
+            # abandoned the stream mid-flight
+            ev["outcome"] = "CANCELLED"
+        trips = {}
+        for point, n in chaos.fired_snapshot().items():
+            d = n - chaos0.get(point, 0)
+            if d > 0:
+                trips[point] = d
+        if trips:
+            # rules that fired while this request was in flight (window
+            # diff — concurrent streams share attribution by design)
+            ev["chaos_trips"] = trips
+        if hbm0 is not None and res.hbm is not None:
+            d = int(res.hbm.pressure_events) - hbm0
+            if d:
+                ev["hbm_pressure_rounds"] = d
+        res.flight.observe(ev)
 
     def _deadline_of(self, request: pb.GenerateRequest) -> Optional[Deadline]:
         """The request's end-to-end budget: explicit ``deadline_ms``
@@ -778,13 +979,23 @@ class GenerateContext(StreamingContext):
         else:
             cost = len(request.prompt) + request.steps
         try:
-            return True, res.admission.admit(
+            ticket = res.admission.admit(
                 tenant=tenant_of_request(request, self.grpc_context),
                 cost=cost,
                 priority=request.priority, deadline=deadline,
                 trace_id=tc.trace_id if tc is not None else None,
                 model=request.model_name)
+            # wide event: the admission verdict + queue wait + the
+            # tenant's DRR deficit at dispatch (tpulab.obs)
+            self._fl_note(admission={
+                "verdict": "admit", "cost": ticket.cost,
+                "queue_wait_s": round(ticket.queue_wait_s, 6),
+                "drr_deficit": round(float(ticket.drr_deficit), 3)})
+            return True, ticket
         except AdmissionRejected as e:
+            self._fl_note(admission={
+                "verdict": "reject", "reason": e.reason,
+                "retry_after_ms": e.retry_after_ms})
             st = pb.RequestStatus(code=pb.RESOURCE_EXHAUSTED,
                                   message=str(e),
                                   retry_after_ms=e.retry_after_ms)
@@ -914,6 +1125,7 @@ class GenerateContext(StreamingContext):
                 else:
                     flush_chunk(steps_eff)
             if stalled:
+                self._fl_note(stalled=True)  # wide event: a latched stall
                 self._hold_stalled_stream(
                     _time.monotonic() + self.SESSION_LEASE_TIMEOUT_S)
                 return  # no final: the stream died stalled, never resolved
@@ -1049,10 +1261,23 @@ class GenerateContext(StreamingContext):
             # its own queue/prefill/decode-chunk spans at the source
             # (scheduler thread), where the RPC layer can't see them
             engine.trace = res.trace
+        flight_kw = {}
+        if res.flight is not None and hasattr(engine, "flight"):
+            from tpulab.serving.admission import tenant_of_request
+            if getattr(engine, "flight", None) is None:
+                # adopt the recorder once (trace-adoption twin): direct
+                # engine completions then record too, and the engine
+                # attaches its per-request summary to every future
+                engine.flight = res.flight
+            # this stream's wide event is assembled HERE — the engine
+            # must summarize (``_tpulab_flight``) but not double-record
+            flight_kw = {"flight_owner": "rpc",
+                         "tenant": tenant_of_request(request,
+                                                     self.grpc_context)}
         tc = TraceContext.of_request(request, self.grpc_context)
         try:
             sampling = self._sampling_of(request)
-            kw = {}
+            kw = dict(flight_kw)
             if deadline is not None:
                 # the batcher's tick sweep enforces it (lane/pages free
                 # before the next step); only passed when present so
@@ -1116,6 +1341,7 @@ class GenerateContext(StreamingContext):
                 # open WITHOUT a final so the client sees a stalled — not
                 # dead — replica and its inter-token watchdog must act
                 finished[0] = True
+                self._fl_note(stalled=True)  # wide event: a latched stall
                 self._hold_stalled_stream(lease_deadline)
                 return
             finished[0] = True
@@ -1142,6 +1368,13 @@ class GenerateContext(StreamingContext):
             log.exception("paged generation failed")
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
                 code=pb.INTERNAL, message=str(e))))
+        finally:
+            if fut is not None:
+                # the engine's completion summary (lane, peak pages,
+                # block sizes, ITL, spec, swaps) — attached to the
+                # future before it resolved, merged into the wide event
+                self._fl_note(
+                    _engine_ev=getattr(fut, "_tpulab_flight", None))
 
 
 class GenerationRejected(RuntimeError):
@@ -1417,10 +1650,47 @@ class RemoteInferenceManager:
         self._health = ClientUnary(
             self._executor, f"/{SERVICE_NAME}/Health",
             pb.HealthRequest.SerializeToString, pb.HealthResponse.FromString)
+        self._debug = ClientUnary(
+            self._executor, f"/{SERVICE_NAME}/Debug",
+            pb.DebugRequest.SerializeToString, pb.DebugResponse.FromString)
 
     def health(self, timeout: float = 10.0) -> pb.HealthResponse:
         """Liveness/readiness probe (reference TRTIS Health)."""
         return self._health.start(pb.HealthRequest()).result(timeout=timeout)
+
+    def debugz(self, model_name: str = "", profile_ticks: int = 0,
+               profile_dir: str = "",
+               timeout: Optional[float] = 30.0) -> dict:
+        """Live engine introspection (tpulab.obs, docs/OBSERVABILITY.md
+        "Debugz"): the parsed snapshot document — lanes, elastic pool
+        ladder position, HBM claims + verify, modelstore leases,
+        admission depths, chaos armament, flight exemplar ids.
+        ``profile_ticks=N`` arms ``jax.profiler`` around the replica's
+        next N batcher ticks; the returned dict then carries
+        ``profile_dir`` (the trace directory on the SERVER's
+        filesystem).  Raises RuntimeError on UNKNOWN_MODEL/INTERNAL."""
+        import json as _json
+        req = pb.DebugRequest(model_name=model_name,
+                              profile_ticks=int(profile_ticks),
+                              profile_dir=profile_dir)
+        resp = self._debug.start(req).result(timeout=timeout)
+        if resp.status.code not in (pb.SUCCESS, 0):
+            raise RuntimeError(
+                f"Debug failed ({pb.StatusCode.Name(resp.status.code)}): "
+                f"{resp.status.message}")
+        snap = _json.loads(resp.snapshot_json) if resp.snapshot_json else {}
+        if resp.profile_dir:
+            snap["profile_dir"] = resp.profile_dir
+        if resp.status.message:
+            snap["debug_message"] = resp.status.message
+        return snap
+
+    def debugz_raw(self, model_name: str = "", profile_ticks: int = 0,
+                   timeout: Optional[float] = 30.0) -> pb.DebugResponse:
+        """The raw DebugResponse (tests / tooling)."""
+        return self._debug.start(pb.DebugRequest(
+            model_name=model_name,
+            profile_ticks=int(profile_ticks))).result(timeout=timeout)
 
     def health_async(self):
         return self._health.start(pb.HealthRequest())

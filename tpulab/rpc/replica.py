@@ -417,6 +417,11 @@ class _BaseReplicaSet:
                 resident = [str(m) for m in
                             getattr(resp, "resident_models", ())]
                 host = [str(m) for m in getattr(resp, "host_models", ())]
+                # per-replica prefix-cache effectiveness (ROADMAP item 1:
+                # prefix-affinity routing tunes against these) — lifetime
+                # counters, sampled into gauges
+                p_hits = int(getattr(resp, "prefix_hits", 0) or 0)
+                p_lookups = int(getattr(resp, "prefix_lookups", 0) or 0)
                 out[addr] = {"queued_requests": int(resp.queued_requests),
                              "free_kv_pages": int(resp.free_kv_pages),
                              # unified HBM economy (tpulab.hbm): the one
@@ -426,7 +431,15 @@ class _BaseReplicaSet:
                                  getattr(resp, "free_hbm_bytes", 0) or 0),
                              "role": role,
                              "resident_models": resident,
-                             "host_models": host}
+                             "host_models": host,
+                             "prefix_hits": p_hits,
+                             "prefix_lookups": p_lookups}
+                m = self._metrics
+                if m is not None and hasattr(m, "prefix_hits"):
+                    # cold path (one Status RPC per replica per poll):
+                    # .labels() here is fine
+                    m.prefix_hits.labels(replica=addr).set(p_hits)
+                    m.prefix_lookups.labels(replica=addr).set(p_lookups)
                 with self._lock:
                     self._load_hint[i] = int(resp.queued_requests)
                     self._role_hint[i] = role

@@ -171,15 +171,21 @@ class AdmissionTicket:
     """One admitted request's capacity hold; ``release()`` (or context
     exit) returns it and dispatches the next queued admission."""
 
-    __slots__ = ("tenant", "cost", "model", "queue_wait_s", "_ctrl",
-                 "_t_admit", "_released")
+    __slots__ = ("tenant", "cost", "model", "queue_wait_s", "drr_deficit",
+                 "_ctrl", "_t_admit", "_released")
 
     def __init__(self, ctrl: "AdmissionController", tenant: str, cost: int,
-                 queue_wait_s: float, model: str = ""):
+                 queue_wait_s: float, model: str = "",
+                 drr_deficit: float = 0.0):
         self.tenant = tenant
         self.cost = cost
         self.model = model
         self.queue_wait_s = queue_wait_s
+        #: the tenant's deficit-round-robin credit at dispatch (0.0 on
+        #: the no-queue fast path) — a wide event (tpulab.obs) records it
+        #: so "why did this tenant's request wait" is answerable per
+        #: request, not just per aggregate
+        self.drr_deficit = drr_deficit
         self._ctrl = ctrl
         self._t_admit = time.perf_counter()
         self._released = False
@@ -278,6 +284,11 @@ class AdmissionController:
     def inflight(self) -> int:
         with self._lock:
             return self._inflight
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Queued admissions per tenant (the debugz live view)."""
+        with self._lock:
+            return self._queue.depths()
 
     def _capacity_ok_locked(self, cost: int, model: str = "") -> bool:
         """Cost-aware dispatch gate: the load source must have the free KV
@@ -539,7 +550,8 @@ class AdmissionController:
                 self.model_inflight.get(w.model, 0) + 1)
             w.ticket = AdmissionTicket(
                 self, w.tenant, w.cost,
-                time.perf_counter() - w.t_enqueue, w.model)
+                time.perf_counter() - w.t_enqueue, w.model,
+                drr_deficit=self._queue.deficit_of(w.tenant))
             w.event.set()
 
     def _on_release(self, ticket: AdmissionTicket) -> None:

@@ -47,6 +47,16 @@ class DeficitRoundRobinQueue:
     def tenants(self) -> List[str]:
         return list(self._ring)
 
+    def depths(self) -> Dict[str, int]:
+        """Queued entries per tenant (the debugz live view)."""
+        return {t: len(q) for t, q in self._fifos.items()}
+
+    def deficit_of(self, tenant: str) -> float:
+        """The tenant's carried DRR deficit (0.0 for idle tenants) —
+        sampled into admission tickets so a wide event can say how much
+        fair-queue credit the request's tenant held at dispatch."""
+        return self._deficit.get(tenant, 0.0)
+
     def push(self, item) -> None:
         """Enqueue; higher ``priority`` jumps ahead within the tenant's
         FIFO (stable within a priority class)."""

@@ -263,6 +263,20 @@ class ReplicaSetMetrics:
             f"{ns}_replica_hedge_wins_total",
             "Hedged requests whose duplicate attempt delivered the first "
             "token (the primary lost the race)", registry=self.registry)
+        # -- per-replica prefix-cache effectiveness (poll_load refreshes
+        # from StatusResponse.prefix_hits/prefix_lookups) — the fleet view
+        # prefix-affinity routing (ROADMAP item 1) needs: a returning
+        # user landing on a random replica shows up here as hit rates
+        # collapsing as the fleet widens ------------------------------------
+        self.prefix_hits = Gauge(
+            f"{ns}_replica_prefix_hits",
+            "Server-reported prefix-cache pages served from cache, "
+            "per replica (lifetime counter sampled as a gauge)",
+            ["replica"], registry=self.registry)
+        self.prefix_lookups = Gauge(
+            f"{ns}_replica_prefix_lookups",
+            "Server-reported prefix-cache pages looked up (hits + "
+            "misses), per replica", ["replica"], registry=self.registry)
 
     # -- hooks (called by the replica sets; cold paths) ---------------------
     def set_breaker_state(self, replica: str, state: str) -> None:

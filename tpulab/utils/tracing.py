@@ -163,6 +163,12 @@ class ChromeTraceRecorder:
         import collections
         self._events = collections.deque(maxlen=max_events)
         self._lock = threading.Lock()
+        #: events the ring has discarded (oldest-first) to stay bounded —
+        #: a saved trace that silently lost its head reads as "the server
+        #: was idle before this window", so the drop count rides save()'s
+        #: otherData and the first drop warns once
+        self.dropped_events = 0
+        self._warned_drop = False
         # paired clock anchor: _epoch0 is the wall-clock instant at which
         # perf_counter read _t0.  Event ts stay perf_counter-relative (sub-
         # microsecond deltas within the process); the anchor rides the
@@ -184,7 +190,21 @@ class ChromeTraceRecorder:
         if args:
             ev["args"] = args
         with self._lock:
-            self._events.append(ev)
+            self._append_locked(ev)
+
+    def _append_locked(self, ev: dict) -> None:
+        """Ring append that COUNTS what the bounded deque would silently
+        discard (deque(maxlen=N) drops the oldest event on overflow)."""
+        if len(self._events) == self._events.maxlen:
+            self.dropped_events += 1
+            if not self._warned_drop:
+                self._warned_drop = True
+                import logging
+                logging.getLogger("tpulab.tracing").warning(
+                    "ChromeTraceRecorder ring full (max_events=%d): oldest "
+                    "events are being dropped; saved traces carry the count "
+                    "in otherData.dropped_events", self._events.maxlen)
+        self._events.append(ev)
 
     def add_counter(self, name: str, ts_s: float, **values) -> None:
         """One counter ('C') sample; ``ts_s`` is a time.perf_counter value
@@ -197,7 +217,7 @@ class ChromeTraceRecorder:
               "ts": round((ts_s - self._t0) * 1e6, 3),
               "args": {k: float(v) for k, v in values.items()}}
         with self._lock:
-            self._events.append(ev)
+            self._append_locked(ev)
 
     def __len__(self) -> int:
         with self._lock:
@@ -210,13 +230,15 @@ class ChromeTraceRecorder:
         import json
         with self._lock:
             events = list(self._events)
+            dropped = self.dropped_events
         if self.process_name:
             events.insert(0, {"name": "process_name", "ph": "M",
                               "pid": self._pid, "tid": 0,
                               "args": {"name": self.process_name}})
         doc = {"traceEvents": events, "displayTimeUnit": "ms",
                "otherData": {"epoch_origin_s": self._epoch0,
-                             "pid": self._pid}}
+                             "pid": self._pid,
+                             "dropped_events": dropped}}
         tmp = f"{path}.tmp.{self._pid}"
         with open(tmp, "w") as f:
             json.dump(doc, f)
