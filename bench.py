@@ -928,6 +928,24 @@ def main() -> None:
     except Exception as e:
         print(f"# failover recovery row skipped: {e!r}", file=sys.stderr)
 
+    # fleet prefix-affinity routing (docs/SERVING.md "Fleet routing &
+    # autoscaling"): a zipfian multi-tenant trace over >=3 loopback
+    # replicas with prefix caches armed, rendezvous affinity ON vs OFF.
+    # The claims tracked: fleet-wide prefix-cache hit rate strictly
+    # higher with affinity ON (one miss per hot prefix fleet-wide
+    # instead of one per replica), no replica starved under the zipf
+    # mix, token parity both modes.  On CPU jit the hit-rate/served
+    # structure is the signal; on-device the TTFT quantiles are (a
+    # prefix hit skips the shared-page prefill on the request path).
+    _phase("prefix_affinity")
+    try:
+        from tpulab.fleet import benchmark_prefix_affinity
+        _record(prefix_affinity=benchmark_prefix_affinity(
+            n_requests=24 if degraded else 36,
+            steps=4 if degraded else 6))
+    except Exception as e:
+        print(f"# prefix affinity row skipped: {e!r}", file=sys.stderr)
+
     # admission control under overload (docs/SERVING.md): offer ~2x the
     # measured capacity with per-request deadlines and record goodput
     # (deadline-met completions/s), shed rate, and p99 admission queue

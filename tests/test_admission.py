@@ -638,7 +638,8 @@ def test_poll_load_reads_status_gauges():
                               "role": "unified",
                               "resident_models": [], "host_models": [],
                               # no prefix cache on a dense engine
-                              "prefix_hits": 0, "prefix_lookups": 0}
+                              "prefix_hits": 0, "prefix_lookups": 0,
+                              "draining": False}  # serving normally
         assert rs._load_hint == [0]
     finally:
         if rs is not None:

@@ -322,3 +322,51 @@ def test_hedge_default_off(pair):
         assert len(got) == 6 and rs.hedges == 0
     finally:
         rs.close()
+
+
+def test_hedge_lands_on_affinity_second_rank(pair):
+    """PR 13 regression: hedging consults the affinity ranking.  With
+    affinity on, the primary is the prompt's rendezvous home and the
+    duplicate launches on the SECOND-ranked replica — never a random
+    spare, never the primary's own replica."""
+    (_, cb_a), _ = pair
+    expected = [int(t) for t in
+                cb_a.submit(PROMPT, STEPS).result(timeout=300)]
+    rs = _set(pair, hedge_delay_s=0.3, prefix_affinity=True,
+              affinity_tokens=8)
+    try:
+        home = rs._preferred(list(PROMPT))
+        second = 1 - home
+        # the hedge's pick IS the affinity second rank
+        picked = rs._hedge_pick(list(PROMPT), frozenset({home}))
+        assert picked == second
+        with rs._lock:
+            rs._inflight[picked] -= 1  # undo the pick's hold
+        # e2e: primary (the home) wedges before its first token; the
+        # duplicate wins from the second rank, bit-exact
+        with chaos.inject("rpc.stream=drop@0+1"):
+            got = [int(t) for t in rs.generate(PROMPT, STEPS)]
+        assert got == expected, (got, expected)
+        assert rs.hedges == 1 and rs.hedge_wins == 1
+        assert rs.served[second] == 1 and rs.served[home] == 0
+    finally:
+        rs.close()
+
+
+def test_hedge_ineligible_without_distinct_second_replica(pair):
+    """PR 13 regression: _hedge_eligible consults routing state, not
+    raw set size — a fleet whose other replica is draining must not
+    hedge (the duplicate could only re-land on the primary's replica),
+    and _hedge_pick never falls back onto an excluded replica."""
+    rs = _set(pair, hedge_delay_s=0.1)
+    try:
+        assert rs._hedge_eligible({}) is True
+        rs.set_draining(rs.addresses[1], True)
+        assert rs._hedge_eligible({}) is False
+        rs.set_draining(rs.addresses[1], False)
+        assert rs._hedge_eligible({}) is True
+        # both replicas excluded (primary + failed): no retry-anyone —
+        # the hedge is skipped rather than duplicated onto the primary
+        assert rs._hedge_pick(list(PROMPT), frozenset({0, 1})) is None
+    finally:
+        rs.close()

@@ -158,6 +158,13 @@ def test_gen_inference_pb2_schema_drift_and_roundtrip():
     assert pb.StatusResponse().prefix_hits == 0    # no prefix cache
     assert pb.StatusResponse().prefix_lookups == 0
 
+    # fleet drain (tpulab.fleet): a draining replica tells every polling
+    # router it must gain nothing new; absent = serving normally
+    dn = pb.StatusResponse.FromString(pb.StatusResponse(
+        draining=True).SerializeToString())
+    assert dn.draining is True
+    assert pb.StatusResponse().draining is False
+
     # debugz (tpulab.obs): the Debug unary RPC's request/response — the
     # snapshot is one JSON document (schema tpulab/obs/debugz.py), the
     # profiler fields round-trip, and zero-value defaults read as "no

@@ -139,6 +139,12 @@ def build_file() -> dp.FileDescriptorProto:
         # prefix-affinity routing tunes against these)
         field("prefix_hits", 10, F.TYPE_INT64),
         field("prefix_lookups", 11, F.TYPE_INT64),
+        # rolling-restart / fleet scale-down drain (tpulab.fleet): the
+        # replica is finishing its in-flight work and must gain NOTHING
+        # new — routers (poll_load) exclude it from every pick and from
+        # the prefix-affinity ring; the autoscaler retires it only once
+        # the drain completes.  false = serving normally.
+        field("draining", 12, F.TYPE_BOOL),
     ])
 
     fd.message_type.add(name="HealthRequest")
@@ -316,6 +322,10 @@ def main() -> int:
         "assert pf.prefix_hits == 7 and pf.prefix_lookups == 9;"
         "assert pb.StatusResponse().prefix_hits == 0;"
         "assert pb.StatusResponse().prefix_lookups == 0;"
+        "dn = pb.StatusResponse(draining=True);"
+        "dn = pb.StatusResponse.FromString(dn.SerializeToString());"
+        "assert dn.draining is True;"
+        "assert pb.StatusResponse().draining is False;"
         "dbq = pb.DebugRequest(model_name='llm', profile_ticks=4,"
         " profile_dir='/tmp/prof');"
         "dbq = pb.DebugRequest.FromString(dbq.SerializeToString());"

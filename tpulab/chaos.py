@@ -82,6 +82,14 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
                               swap-in discard the host copy and serve a
                               cold rebuild instead: degraded weights are
                               always REBUILT weights, never a corrupt serve
+    fleet.route               GenerationReplicaSet._pick_affine, the head
+                              of the prefix-affinity routing decision
+                              (tpulab.fleet) — error fails that decision
+                              and the pick degrades to the existing
+                              load-based selection; drop disables
+                              affinity for that request (same fallback,
+                              distinct evidence): routing chaos can only
+                              forgo cache warmth, never strand a request
     hbm.pressure              HBMArbiter decision sites (tpulab.hbm): one
                               trip per pressed tenant per pressure round
                               (demote-KV, evict-model) and one at the

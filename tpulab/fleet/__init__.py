@@ -1,0 +1,28 @@
+"""Fleet layer: prefix-affinity routing + queue-wait-driven autoscaling
+(ROADMAP item 1) — the scheduling layer ABOVE the replica sets.
+
+- :mod:`tpulab.fleet.router` — rendezvous (HRW) hashing over the
+  prompt-prefix digest with load-aware spill-over: the fleet behaves
+  like one large prefix cache, and membership changes move only ~1/N of
+  digests (measured: ``ring_moves``).
+- :mod:`tpulab.fleet.autoscaler` — scale-up on admission queue-wait
+  EWMA / overload fast-fails, scale-down by drain-before-retire over a
+  pluggable :class:`ReplicaProvider`.
+
+Consumed by :class:`tpulab.rpc.replica.GenerationReplicaSet`
+(``prefix_affinity=True`` routes through the HRW router; the set's
+``add_replica`` / ``set_draining`` / ``retire_replica`` membership
+surface is what the autoscaler drives).  docs/SERVING.md "Fleet routing
+& autoscaling".
+"""
+
+from tpulab.fleet.autoscaler import (FleetAutoscaler,  # noqa: F401
+                                     InProcessReplicaProvider,
+                                     ReplicaProvider)
+from tpulab.fleet.bench import benchmark_prefix_affinity  # noqa: F401
+from tpulab.fleet.router import (PrefixAffinityRouter,  # noqa: F401
+                                 prefix_digest)
+
+__all__ = ["PrefixAffinityRouter", "prefix_digest", "FleetAutoscaler",
+           "ReplicaProvider", "InProcessReplicaProvider",
+           "benchmark_prefix_affinity"]

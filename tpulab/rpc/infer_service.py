@@ -229,6 +229,9 @@ class StatusContext(Context):
         resp.prefix_hits = prefix_hits
         resp.prefix_lookups = prefix_lookups
         resp.role = res.role
+        # rolling-restart / fleet scale-down drain (tpulab.fleet): tell
+        # every polling router this replica must gain nothing new
+        resp.draining = res.draining
         if res.hbm is not None:
             # unified HBM economy (tpulab.hbm): ONE honest headroom
             # gauge next to the per-pool page count

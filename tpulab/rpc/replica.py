@@ -108,6 +108,7 @@ class _BaseReplicaSet:
             raise ValueError("need at least one replica address")
         self.addresses = list(addresses)
         self.model_name = model_name
+        self._channels = channels
         self._managers = [RemoteInferenceManager(a, channels=channels)
                           for a in self.addresses]
         self._inflight = [0] * len(self._managers)
@@ -137,6 +138,20 @@ class _BaseReplicaSet:
         #: via poll_load()); None = the replica never reported residency
         #: (no modelstore) and the preference stays neutral
         self._hot_hint: List[Optional[bool]] = [None] * len(self._managers)
+        #: last server-reported free_hbm_bytes per replica (Status RPC via
+        #: poll_load; None = the replica reports no arbiter) — the fleet
+        #: router's spill signal
+        self._hbm_hint: List[Optional[int]] = [None] * len(self._managers)
+        # -- fleet membership (tpulab.fleet): draining replicas finish
+        # what they have and gain NOTHING new; retired replicas are
+        # tombstoned — the slot stays (in-flight callbacks index by
+        # position; reshuffling indices under live requests would corrupt
+        # the accounting) but is excluded from every pick and its channel
+        # is closed --------------------------------------------------------
+        self._draining = [False] * len(self._managers)
+        self._retired: set = set()
+        #: max_failover=None tracks ACTIVE membership as the fleet scales
+        self._max_failover_auto = max_failover is None
         self._max_failover = (len(self._managers) if max_failover is None
                               else max_failover)
         # -- circuit breaker (0/None disables) ------------------------------
@@ -231,9 +246,13 @@ class _BaseReplicaSet:
     # -- circuit breaker ----------------------------------------------------
     def breaker_states(self) -> Dict[str, str]:
         """Per-replica breaker state: ``closed`` (routing normally),
-        ``open`` (ejected), ``probing`` (ejected, re-probe in flight)."""
+        ``open`` (ejected), ``probing`` (ejected, re-probe in flight) —
+        plus the fleet lifecycle states ``draining`` (finishing, gains
+        nothing new) and ``retired`` (tombstoned, channel closed)."""
         with self._lock:
-            return {a: ("probing" if i in self._probing
+            return {a: ("retired" if i in self._retired
+                        else "draining" if self._draining[i]
+                        else "probing" if i in self._probing
                         else "open" if i in self._open else "closed")
                     for i, a in enumerate(self.addresses)}
 
@@ -364,6 +383,103 @@ class _BaseReplicaSet:
                         self._probe_next[idx] = time.monotonic() + iv
                         self._note_breaker(idx, "open")  # probe failed
 
+    # -- fleet membership (tpulab.fleet.FleetAutoscaler drives these) -------
+    def _on_add_replica_locked(self, idx: int, manager) -> None:
+        """Subclass hook: extend per-replica parallel state.  CALLER
+        HOLDS self._lock."""
+
+    def add_replica(self, address: str) -> int:
+        """Scale-up: join ``address`` to the set (routable immediately).
+        Returns its index.  Re-joining a retired address adds a fresh
+        slot — the tombstoned one stays closed."""
+        mgr = RemoteInferenceManager(address, channels=self._channels)
+        with self._lock:
+            idx = len(self._managers)
+            self.addresses.append(address)
+            self._managers.append(mgr)
+            self._inflight.append(0)
+            self.served.append(0)
+            self._backoff_until.append(0.0)
+            self._load_hint.append(0)
+            self._role_hint.append("")
+            self._hot_hint.append(None)
+            self._hbm_hint.append(None)
+            self._draining.append(False)
+            self._fail_streak.append(0)
+            if self._max_failover_auto:
+                self._max_failover = self._active_count_locked()
+            if self._metrics is not None:
+                self._m_inflight.append(
+                    self._metrics.inflight.labels(replica=address))
+                self._m_requests.append(
+                    self._metrics.requests.labels(replica=address))
+                if hasattr(self._metrics, "set_breaker_state"):
+                    self._metrics.set_breaker_state(address, "closed")
+            self._on_add_replica_locked(idx, mgr)
+        log.info("replica %s joined the set (index %d)", address, idx)
+        return idx
+
+    def set_draining(self, address: str, draining: bool = True) -> None:
+        """Router-local drain flag: a draining replica finishes its
+        in-flight work but is excluded from every new pick (and from the
+        affinity ring).  ``poll_load`` also sets it from the server-
+        reported ``StatusResponse.draining``, so any router polling a
+        draining replica learns without being told."""
+        with self._lock:
+            self._draining[self.addresses.index(address)] = bool(draining)
+            if self._max_failover_auto:
+                self._max_failover = self._active_count_locked()
+
+    def retire_replica(self, address: str) -> None:
+        """Scale-down completion: tombstone the (drained) replica — out
+        of every pick and ring forever — and close its channel.  Indices
+        of other replicas never move (in-flight callbacks hold them)."""
+        with self._lock:
+            idx = self.addresses.index(address)
+            self._retired.add(idx)
+            self._draining[idx] = False
+            self._open.discard(idx)
+            self._probing.discard(idx)
+            self._probe_next.pop(idx, None)
+            self._probe_interval.pop(idx, None)
+            if self._max_failover_auto:
+                self._max_failover = self._active_count_locked()
+            mgr = self._managers[idx]
+        log.info("replica %s retired from the set", address)
+        try:
+            mgr.close()
+        except Exception:  # pragma: no cover - teardown best-effort
+            pass
+
+    def _active_locked(self) -> List[int]:
+        """Indices eligible for NEW work: not retired, not draining.
+        CALLER HOLDS self._lock.  (Breaker-open replicas stay listed —
+        they are sick, not leaving; the pick-time fallbacks own them.)"""
+        return [i for i in range(len(self._managers))
+                if i not in self._retired and not self._draining[i]]
+
+    def _active_count_locked(self) -> int:
+        return max(1, len(self._active_locked()))
+
+    @property
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active_locked())
+
+    def active_addresses(self) -> List[str]:
+        with self._lock:
+            return [self.addresses[i] for i in self._active_locked()]
+
+    def load_hints(self) -> Dict[str, int]:
+        """Last server-reported queue depth per replica (poll_load)."""
+        with self._lock:
+            return dict(zip(self.addresses, self._load_hint))
+
+    def draining_addresses(self) -> List[str]:
+        with self._lock:
+            return [a for i, a in enumerate(self.addresses)
+                    if self._draining[i] and i not in self._retired]
+
     # -- health -------------------------------------------------------------
     def health(self, timeout: float = 10.0) -> Dict[str, dict]:
         """Per-replica liveness/readiness (exceptions become dead
@@ -372,7 +488,11 @@ class _BaseReplicaSet:
         replica's circuit: an explicit health() IS a probe."""
         out: Dict[str, dict] = {}
         futs = []
-        for a, m in zip(self.addresses, self._managers):
+        with self._lock:
+            retired = set(self._retired)
+        for i, (a, m) in enumerate(zip(self.addresses, self._managers)):
+            if i in retired:
+                continue  # tombstoned: channel closed, nothing to probe
             try:
                 futs.append((a, m.health_async()))
             except Exception as e:  # noqa: BLE001 - submission itself failed
@@ -405,7 +525,11 @@ class _BaseReplicaSet:
         (they are routed around by health/breaker, not by load)."""
         out: Dict[str, dict] = {}
         futs = []
+        with self._lock:
+            retired = set(self._retired)
         for i, (a, m) in enumerate(zip(self.addresses, self._managers)):
+            if i in retired:
+                continue  # tombstoned: channel closed, nothing to poll
             try:
                 futs.append((i, a, m.server_status_async()))
             except Exception as e:  # noqa: BLE001 - submission failed
@@ -422,6 +546,10 @@ class _BaseReplicaSet:
                 # counters, sampled into gauges
                 p_hits = int(getattr(resp, "prefix_hits", 0) or 0)
                 p_lookups = int(getattr(resp, "prefix_lookups", 0) or 0)
+                # rolling-restart / scale-down drain: the replica is
+                # finishing its in-flight work and must gain nothing new
+                drn = bool(getattr(resp, "draining", False))
+                free_hbm = int(getattr(resp, "free_hbm_bytes", 0) or 0)
                 out[addr] = {"queued_requests": int(resp.queued_requests),
                              "free_kv_pages": int(resp.free_kv_pages),
                              # unified HBM economy (tpulab.hbm): the one
@@ -433,7 +561,8 @@ class _BaseReplicaSet:
                              "resident_models": resident,
                              "host_models": host,
                              "prefix_hits": p_hits,
-                             "prefix_lookups": p_lookups}
+                             "prefix_lookups": p_lookups,
+                             "draining": drn}
                 m = self._metrics
                 if m is not None and hasattr(m, "prefix_hits"):
                     # cold path (one Status RPC per replica per poll):
@@ -443,6 +572,17 @@ class _BaseReplicaSet:
                 with self._lock:
                     self._load_hint[i] = int(resp.queued_requests)
                     self._role_hint[i] = role
+                    # 0 = "no arbiter served" by proto convention; the
+                    # spill signal only trusts a real report
+                    self._hbm_hint[i] = free_hbm if free_hbm else None
+                    if drn:
+                        # OR, don't overwrite: the controlling router may
+                        # have flagged the drain locally BEFORE the
+                        # server's readiness flip landed — un-draining
+                        # goes through set_draining(addr, False)
+                        self._draining[i] = True
+                        if self._max_failover_auto:
+                            self._max_failover = self._active_count_locked()
                     # multi-model residency: only meaningful when the
                     # replica runs a modelstore (it reports SOME list);
                     # single-model replicas stay neutral (None)
@@ -467,18 +607,21 @@ class _BaseReplicaSet:
         fallbacks: backoff is ignored before open is (a merely-overloaded
         replica beats a dead one), and when every non-excluded replica is
         open the pick still attempts traffic (the attempt doubles as a
-        live probe).  CALLER HOLDS self._lock; does NOT bump inflight —
-        the single shared selection algorithm."""
+        live probe).  Draining and retired replicas (fleet scale-down)
+        are out of EVERY tier — they must finish what they have and gain
+        nothing new, even as a last resort.  CALLER HOLDS self._lock;
+        does NOT bump inflight — the single shared selection algorithm."""
         now = time.monotonic()
-        candidates = [(n, i) for i, n in enumerate(self._inflight)
+        live = self._active_locked()
+        candidates = [(self._inflight[i], i) for i in live
                       if i not in exclude and i not in self._open
                       and self._backoff_until[i] <= now]
         if not candidates:  # everyone healthy is backing off: prefer an
             #                 overloaded replica over a breaker-open one
-            candidates = [(n, i) for i, n in enumerate(self._inflight)
+            candidates = [(self._inflight[i], i) for i in live
                           if i not in exclude and i not in self._open]
         if not candidates:
-            candidates = [(n, i) for i, n in enumerate(self._inflight)
+            candidates = [(self._inflight[i], i) for i in live
                           if i not in exclude]
         if not candidates:
             return None
@@ -555,6 +698,10 @@ class ReplicaSet(_BaseReplicaSet):
         # RPC, which must neither run twice per replica nor serialize
         # against _pick/_submit bookkeeping on the shared lock
         self._runner_locks = [threading.Lock() for _ in self._managers]
+
+    def _on_add_replica_locked(self, idx: int, manager) -> None:
+        self._runners.append(None)  # built lazily on first pick
+        self._runner_locks.append(threading.Lock())
 
     def _runner(self, idx: int, timeout: Optional[float] = None):
         """The replica's runner, built on first use (raises if the replica
@@ -687,16 +834,23 @@ class GenerationReplicaSet(_BaseReplicaSet):
     """Least-loaded routing + exactly-once replay failover for
     token-streaming generation (module docstring: determinism contract).
 
-    ``prefix_affinity=True`` adds prefix-cache-aware routing: requests
-    whose prompts share their first ``affinity_tokens`` tokens hash to
-    the same preferred replica, so a replica's ref-counted prefix cache
-    (engine/paged.py PrefixCache) keeps serving the prompts it has
-    already prefilled — the cross-replica analog of the in-engine cache.
-    Affinity is a PREFERENCE, not a pin: when the preferred replica
-    carries more than ``affinity_slack`` requests above the least-loaded
-    one (or is excluded by failover), routing falls back to least-loaded
-    — cache warmth must never become a hotspot or a single point of
-    failure.
+    ``prefix_affinity=True`` adds prefix-cache-aware routing
+    (tpulab.fleet.router, docs/SERVING.md "Fleet routing &
+    autoscaling"): requests whose prompts share their first
+    ``affinity_tokens`` tokens rendezvous-hash (HRW) to the same home
+    replica, so a replica's ref-counted prefix cache (engine/paged.py
+    PrefixCache) keeps serving the prompts it has already prefilled —
+    the cross-replica analog of the in-engine cache, stable under
+    membership changes (an autoscaler join/retire re-homes only ~1/N of
+    prefixes).  Affinity is a PREFERENCE, not a pin: the winner is
+    SPILLED to the next hash rank when its load gauges say it is hot
+    (local inflight beyond ``affinity_slack`` over the least-loaded ring
+    member, reported queue depth at ``spill_queue_depth``, free HBM
+    under ``min_free_hbm_bytes``), and breaker-open/draining/retired
+    replicas are excluded from the ring — cache warmth must never become
+    a hotspot or a single point of failure.  Hedged first tokens hedge
+    onto the affinity SECOND rank and the disagg decode handoff ranks
+    within the decode role, so neither interaction defeats affinity.
 
     ``disaggregate=True`` adds role-aware prefill/decode routing
     (tpulab.disagg, docs/SERVING.md "Replica roles"): greedy and
@@ -731,7 +885,9 @@ class GenerationReplicaSet(_BaseReplicaSet):
     def __init__(self, addresses: Sequence[str], model_name: str,
                  channels: int = 1, max_failover: Optional[int] = None,
                  prefix_affinity: bool = False, affinity_tokens: int = 32,
-                 affinity_slack: int = 2, metrics=None,
+                 affinity_slack: int = 2,
+                 spill_queue_depth: Optional[int] = None,
+                 min_free_hbm_bytes: int = 0, router=None, metrics=None,
                  disaggregate: bool = False,
                  resume_failover: bool = True,
                  ttft_timeout_s: Optional[float] = None,
@@ -742,8 +898,8 @@ class GenerationReplicaSet(_BaseReplicaSet):
         self._clients = [GenerateStreamClient(m, model_name)
                         for m in self._managers]
         self.prefix_affinity = prefix_affinity
-        self.affinity_tokens = affinity_tokens
-        self.affinity_slack = affinity_slack
+        # affinity_tokens / affinity_slack live on the router (properties
+        # below proxy them); constructed at the end of __init__
         #: resubmit failovers as resume-from-delivered when the sampling
         #: stream survives the hop (False = always full replay)
         self.resume_failover = resume_failover
@@ -772,35 +928,151 @@ class GenerationReplicaSet(_BaseReplicaSet):
         self.disagg_handoffs = 0
         #: requests that degraded to unified routing (tests)
         self.disagg_fallbacks = 0
+        #: the fleet routing policy (tpulab.fleet.PrefixAffinityRouter):
+        #: rendezvous ranking + spill thresholds + hit/spill/ring-move
+        #: counters.  Constructed even with prefix_affinity=False so a
+        #: later autoscaler attach finds the membership accounting live.
+        from tpulab.fleet.router import PrefixAffinityRouter
+        self.router = (router if router is not None
+                       else PrefixAffinityRouter(
+                           affinity_tokens=affinity_tokens,
+                           inflight_slack=affinity_slack,
+                           spill_queue_depth=spill_queue_depth,
+                           min_free_hbm_bytes=min_free_hbm_bytes,
+                           metrics=metrics))
+
+    def _on_add_replica_locked(self, idx: int, manager) -> None:
+        self._clients.append(GenerateStreamClient(manager, self.model_name))
+
+    @property
+    def affinity_tokens(self) -> int:
+        return self.router.affinity_tokens
+
+    @affinity_tokens.setter
+    def affinity_tokens(self, n: int) -> None:
+        self.router.affinity_tokens = int(n)
+
+    @property
+    def affinity_slack(self) -> int:
+        return self.router.inflight_slack
+
+    @affinity_slack.setter
+    def affinity_slack(self, n: int) -> None:
+        self.router.inflight_slack = int(n)
+
+    def _ring_locked(self) -> List[int]:
+        """Affinity-ring membership: active (not draining, not retired)
+        and not breaker-open — a sick or leaving replica must not be a
+        prefix home.  CALLER HOLDS self._lock."""
+        return [i for i in self._active_locked() if i not in self._open]
 
     def _preferred(self, prompt) -> int:
-        """Stable prefix-hash home for a prompt (same first
-        ``affinity_tokens`` tokens -> same replica)."""
-        import hashlib
-        prefix = b",".join(b"%d" % int(t)
-                           for t in prompt[:self.affinity_tokens])
-        digest = hashlib.blake2s(prefix, digest_size=4).digest()
-        return int.from_bytes(digest, "little") % len(self._managers)
-
-    def _pick_affine(self, prompt, exclude: frozenset) -> Optional[int]:
-        """The pref short-circuit over the shared selection algorithm;
-        mirrors _pick_or_any's all-excluded fallback (retry anyone)."""
-        pref = self._preferred(prompt)
+        """Stable rendezvous home for a prompt (same first
+        ``affinity_tokens`` tokens -> same replica; a membership change
+        re-homes only ~1/N of digests — tpulab.fleet.router)."""
+        from tpulab.fleet.router import prefix_digest
+        digest = prefix_digest(prompt, self.affinity_tokens)
         with self._lock:
-            loads = [n for i, n in enumerate(self._inflight)
-                     if i not in exclude]
-            if not loads:  # every replica already failed this request
-                idx = self._pick_locked(frozenset())
-            elif (pref not in exclude and pref not in self._open
-                    and self._inflight[pref] <= min(loads)
-                    + self.affinity_slack):
-                idx = pref
-            else:  # overloaded/ejected/dead home: shared least-loaded policy
-                idx = self._pick_locked(exclude)
+            addr_of = {self.addresses[i]: i for i in self._ring_locked()}
+        if not addr_of:
+            return 0
+        return addr_of[self.router.rank(digest, sorted(addr_of))[0]]
+
+    def _pick_affine(self, prompt, exclude: frozenset,
+                     allowed: Optional[frozenset] = None) -> Optional[int]:
+        """The affinity pick: rendezvous-rank the ring for the prompt's
+        prefix digest (tpulab.fleet.PrefixAffinityRouter) and take the
+        highest rank that is neither excluded nor spilled for load —
+        the winner is skipped when its gauges (local inflight, reported
+        queue depth, free HBM) say it is hot, so a hot prefix warms a
+        stable second replica instead of hot-spotting its home.  An
+        empty/exhausted ring degrades to the shared load-based pick
+        (mirroring _pick_or_any's retry-anyone fallback), so affinity
+        can delay a request's best placement but never strand it.
+
+        The ``fleet.route`` chaos trip sits at the head: ``error`` fails
+        this routing decision, ``drop`` disables affinity for the
+        request — both degrade to the load-based pick.
+
+        ``allowed`` restricts candidates to a role subset (disagg
+        decode-side affinity); restricted picks return None when the
+        subset is unroutable (the caller owns the role fallback) and do
+        not touch the global ring-membership accounting."""
+        from tpulab import chaos
+        from tpulab.fleet.router import prefix_digest
+
+        def load_pick() -> Optional[int]:
+            if allowed is None:
+                return self._pick_or_any(exclude)
+            blocked = frozenset(range(len(self._managers))) - allowed
+            return self._pick(exclude | blocked)
+
+        try:
+            if chaos.trip("fleet.route") == "drop":
+                return load_pick()  # affinity disabled for this request
+        except chaos.ChaosError:
+            return load_pick()      # routing decision failed: load-based
+        digest = prefix_digest(prompt, self.affinity_tokens)
+        ranked: List[int] = []
+        spilled = False
+        with self._lock:
+            ring = [i for i in self._ring_locked()
+                    if allowed is None or i in allowed]
+            if allowed is None:
+                # global-ring membership accounting (ring_moves); role
+                # subsets are views, not membership changes
+                self.router.note_membership(
+                    self.addresses[i] for i in ring)
+            idx = None
+            if ring:
+                addr_of = {self.addresses[i]: i for i in ring}
+                ranked = [addr_of[a] for a in
+                          self.router.rank(digest, sorted(addr_of))]
+                eligible = [i for i in ranked if i not in exclude]
+                if eligible:
+                    lo = min(self._inflight[i] for i in eligible)
+                    for i in eligible:
+                        if self.router.should_spill(
+                                self._inflight[i], lo,
+                                self._load_hint[i], self._hbm_hint[i]):
+                            if i == ranked[0]:
+                                spilled = True
+                            continue
+                        idx = i
+                        break
             if idx is not None:
                 self._inflight[idx] += 1
                 self._note_inflight(idx)
-            return idx
+        if idx is None:
+            # ring empty, every member excluded, or everything spilled:
+            # the shared load-based policy finishes the job
+            return load_pick()
+        self.router.note_routed(digest, self.addresses[idx],
+                                self.addresses[ranked[0]], spilled)
+        return idx
+
+    def _hedge_pick(self, prompt, exclude: frozenset) -> Optional[int]:
+        """The hedge's replica: with affinity on, the highest-ranked
+        ring member that is not the primary — the affinity SECOND rank,
+        never a random spare, so the duplicate lands where the prefix
+        would live next (spill rules don't apply: a hedge is rescue
+        traffic).  Without affinity, the plain load pick.  Either way
+        there is NO retry-anyone fallback — a duplicate that re-lands on
+        the primary's replica is not a hedge (see _hedge_eligible)."""
+        if self.prefix_affinity:
+            from tpulab.fleet.router import prefix_digest
+            digest = prefix_digest(prompt, self.affinity_tokens)
+            with self._lock:
+                ring = [i for i in self._ring_locked()
+                        if i not in exclude]
+                if not ring:
+                    return None
+                addr_of = {self.addresses[i]: i for i in ring}
+                idx = addr_of[self.router.rank(digest, sorted(addr_of))[0]]
+                self._inflight[idx] += 1
+                self._note_inflight(idx)
+                return idx
+        return self._pick(exclude)
 
     def generate(self, prompt, steps: int, timeout: float = 300.0,
                  deadline_s: Optional[float] = None, **kw):
@@ -905,16 +1177,25 @@ class GenerationReplicaSet(_BaseReplicaSet):
     def _hedge_eligible(self, kw: dict) -> bool:
         """Hedge only when it cannot hurt: never host-sampled (the
         duplicate's PRNG stream would not be the same request), never
-        with a single replica, and never while ANY replica is in
-        overload backoff — a hedge under overload is the amplification
-        admission control exists to prevent."""
-        if self.hedge_delay_s is None or len(self._managers) < 2:
+        without a DISTINCT routable second replica, and never while ANY
+        routable replica is in overload backoff — a hedge under overload
+        is the amplification admission control exists to prevent.
+
+        Routing state counts, not raw set size: draining and retired
+        members cannot take a duplicate, so a fleet scaled down to one
+        active replica must not hedge — the old ``len(managers) < 2``
+        check would launch a duplicate that could only re-land on the
+        primary's own replica."""
+        if self.hedge_delay_s is None:
             return False
         if not self._stream_survives_hop(kw):
             return False
         now = time.monotonic()
         with self._lock:
-            return not any(b > now for b in self._backoff_until)
+            active = self._active_locked()
+            if len(active) < 2:
+                return False
+            return not any(self._backoff_until[i] > now for i in active)
 
     def _generate_iter(self, prompt, steps, timeout, kw,
                        already_delivered: int = 0,
@@ -1104,7 +1385,18 @@ class GenerationReplicaSet(_BaseReplicaSet):
                     self._note_inflight(att.idx)
 
         def launch(no: int, exclude: set) -> Optional["_Attempt"]:
-            idx = self._pick_or_any(frozenset(exclude))
+            if no == 0:
+                # the primary rides the same affinity policy as ordinary
+                # streams — a hedged request must not defeat cache warmth
+                idx = (self._pick_affine(prompt, frozenset(exclude))
+                       if self.prefix_affinity
+                       else self._pick_or_any(frozenset(exclude)))
+            else:
+                # the duplicate: affinity second rank / strict load pick,
+                # never the retry-anyone fallback (a hedge that re-lands
+                # on the primary's replica is not a hedge) — None skips
+                # the hedge and the primary keeps its watchdog/failover
+                idx = self._hedge_pick(prompt, frozenset(exclude))
             if idx is None:
                 return None
             att = _Attempt(idx, no)
@@ -1321,8 +1613,16 @@ class GenerationReplicaSet(_BaseReplicaSet):
         if steps <= 1 or int(first) in stops:
             self.disagg_handoffs += 1  # one-token request: prefill WAS it
             return
-        # -- hop 2: shipped-KV decode ---------------------------------------
-        didx = self._pick(frozenset(range(len(self._managers))) - decodes)
+        # -- hop 2: shipped-KV decode.  With affinity on, the decode-side
+        # pick rendezvous-ranks WITHIN the decode role so this prefix's
+        # shipped KV keeps landing on the same decode replica — its host
+        # tier already holds the ("ship", digest) entries from earlier
+        # requests; a random decode pick would scatter them fleet-wide
+        didx = (self._pick_affine(prompt, frozenset(),
+                                  allowed=frozenset(decodes))
+                if self.prefix_affinity
+                else self._pick(frozenset(range(len(self._managers)))
+                                - decodes))
         if didx is None:
             yield from fallback(delivered, toks)
             return

@@ -264,6 +264,13 @@ class AdmissionController:
         self._tenant_buckets: Dict[str, TokenBucket] = {}
         self._service_ewma = (cfg.expected_service_s
                               if cfg.expected_service_s > 0 else None)
+        #: EWMA of the queue wait admitted requests ACTUALLY paid
+        #: (ticket.queue_wait_s at admit; 0.0 rides the no-queue fast
+        #: path, pulling the average down when capacity is plentiful) —
+        #: the fleet autoscaler's scale-up/down signal
+        #: (tpulab.fleet.FleetAutoscaler wait_signal, docs/SERVING.md
+        #: "Fleet routing & autoscaling")
+        self._queue_wait_ewma: Optional[float] = None
         # -- observability (test-assertable without prometheus) -------------
         self.admitted_total = 0
         self.rejected_total = 0
@@ -289,6 +296,15 @@ class AdmissionController:
         """Queued admissions per tenant (the debugz live view)."""
         with self._lock:
             return self._queue.depths()
+
+    @property
+    def queue_wait_ewma_s(self) -> float:
+        """EWMA of the queue wait admitted requests actually paid
+        (seconds; 0.0 before any admission) — the load signal the fleet
+        autoscaler scales on: waiting requests mean the fleet is short a
+        replica long before anything is rejected."""
+        with self._lock:
+            return self._queue_wait_ewma or 0.0
 
     def _capacity_ok_locked(self, cost: int, model: str = "") -> bool:
         """Cost-aware dispatch gate: the load source must have the free KV
@@ -579,6 +595,10 @@ class AdmissionController:
                        t0: float, trace_id: Optional[str]) -> None:
         with self._lock:
             self.admitted_total += 1
+            w = ticket.queue_wait_s
+            self._queue_wait_ewma = (w if self._queue_wait_ewma is None
+                                     else 0.8 * self._queue_wait_ewma
+                                     + 0.2 * w)
         if self._metrics is not None:
             self._metrics.note_admitted(tenant, ticket.queue_wait_s)
         if self.trace is not None:

@@ -277,6 +277,25 @@ class ReplicaSetMetrics:
             f"{ns}_replica_prefix_lookups",
             "Server-reported prefix-cache pages looked up (hits + "
             "misses), per replica", ["replica"], registry=self.registry)
+        # -- prefix-affinity routing (tpulab.fleet.router): did requests
+        # land on their rendezvous home, and how much cache warmth do
+        # membership changes cost ----------------------------------------
+        self.affinity_hits = Counter(
+            f"{ns}_replica_affinity_hits_total",
+            "Requests routed to their prefix-affinity winner (rank 0 of "
+            "the rendezvous ring)", registry=self.registry)
+        self.affinity_spills = Counter(
+            f"{ns}_replica_affinity_spills_total",
+            "Requests whose affinity winner was skipped for load (queue "
+            "depth / inflight / free-HBM spill thresholds) — the "
+            "hot-prefix-never-a-hot-spot contract, counted",
+            registry=self.registry)
+        self.ring_moves = Counter(
+            f"{ns}_replica_ring_moves_total",
+            "Sampled prefix digests re-homed by ring membership changes "
+            "(breaker ejections, drains, scale up/down) — rendezvous "
+            "hashing keeps this near sampled/N per change",
+            registry=self.registry)
 
     # -- hooks (called by the replica sets; cold paths) ---------------------
     def set_breaker_state(self, replica: str, state: str) -> None:
@@ -319,6 +338,66 @@ class ReplicaSetMetrics:
             self.hedge_wins.inc()
         else:
             self.hedges.inc()
+
+    # -- prefix-affinity hooks (tpulab.fleet.router) --------------------
+    def note_affinity(self, hit: bool) -> None:
+        if hit:
+            self.affinity_hits.inc()
+        else:
+            self.affinity_spills.inc()
+
+    def note_ring_moves(self, n: int = 1) -> None:
+        if n > 0:
+            self.ring_moves.inc(n)
+
+
+class FleetMetrics:
+    """Observability for the fleet autoscaler
+    (:mod:`tpulab.fleet.autoscaler`): membership actions and the
+    queue-wait signal it scales on — the elasticity telemetry the
+    adaptive-orchestration line in PAPERS.md argues a scale controller
+    needs in order to be tunable (is it flapping? is the wait threshold
+    doing work?)."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.scale_ups = Counter(
+            f"{ns}_fleet_scale_ups_total",
+            "Replicas added by the autoscaler", registry=self.registry)
+        self.scale_downs = Counter(
+            f"{ns}_fleet_scale_downs_total",
+            "Replicas retired by the autoscaler (drain completed)",
+            registry=self.registry)
+        self.drains = Counter(
+            f"{ns}_fleet_drains_total",
+            "Scale-down drains started (victim flagged draining; retired "
+            "only once in-flight work completes)", registry=self.registry)
+        self.replicas = Gauge(
+            f"{ns}_fleet_replicas",
+            "Active (routable, non-draining) replicas in the set",
+            registry=self.registry)
+        self.queue_wait = Gauge(
+            f"{ns}_fleet_queue_wait_ewma_seconds",
+            "The admission queue-wait EWMA the controller last evaluated "
+            "(AdmissionController.queue_wait_ewma_s)",
+            registry=self.registry)
+
+    # -- hooks (called by the autoscaler; cold paths) -------------------
+    def note_scale(self, up: bool) -> None:
+        (self.scale_ups if up else self.scale_downs).inc()
+
+    def note_drain(self) -> None:
+        self.drains.inc()
+
+    def set_replicas(self, n: int) -> None:
+        self.replicas.set(n)
+
+    def set_queue_wait(self, seconds: float) -> None:
+        self.queue_wait.set(max(0.0, float(seconds)))
 
 
 class GenerationMetrics:
