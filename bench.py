@@ -946,6 +946,23 @@ def main() -> None:
     except Exception as e:
         print(f"# prefix affinity row skipped: {e!r}", file=sys.stderr)
 
+    # offline batch lane (docs/SERVING.md "Offline batch lane"): a
+    # diurnal online trace — bursts separated by idle valleys — with the
+    # preemptible batch lane ON vs OFF.  The claims tracked: total
+    # tokens/s strictly higher with the lane on (idle capacity converts
+    # to bulk tokens), online p99 TTFT/ITL flat within noise under the
+    # SAME online trace, batch preemptions observed (bursts really evict
+    # the lane), and the preempted job's output bit-exact vs an
+    # uncontended run.
+    _phase("batch_soak")
+    try:
+        from tpulab.batch import benchmark_batch_soak
+        _record(batch_soak=benchmark_batch_soak(
+            n_cycles=3 if degraded else 4,
+            n_batch_items=12 if degraded else 24))
+    except Exception as e:
+        print(f"# batch soak row skipped: {e!r}", file=sys.stderr)
+
     # admission control under overload (docs/SERVING.md): offer ~2x the
     # measured capacity with per-request deadlines and record goodput
     # (deadline-met completions/s), shed rate, and p99 admission queue

@@ -337,3 +337,43 @@ def test_replicaset_disagg_falls_back_without_roles(lm):
             rs.close()
         mu.shutdown()
         cbu.shutdown()
+
+
+def test_disagg_prefill_side_affinity_keeps_prompt_kv_home(lm):
+    """ROADMAP item 1 follow-up (b): with prefix affinity on, the
+    prefill-side pick rendezvous-ranks WITHIN the prefill role — every
+    request sharing a prompt prefix runs its prefill on the SAME
+    prefill replica (its prefix cache / host tier stay warm), instead
+    of the load-only spread that paid one cold prefill per replica."""
+    from tpulab.rpc.replica import GenerationReplicaSet
+    mp1, cbp1 = _serve(lm, "prefill")
+    mp2, cbp2 = _serve(lm, "prefill")
+    md, cbd = _serve(lm, "decode")
+    rs = None
+    try:
+        rng = np.random.default_rng(11)
+        prefix = rng.integers(0, 64, (12,), np.int32)
+        addrs = [f"127.0.0.1:{m.server.bound_port}"
+                 for m in (mp1, mp2, md)]
+        rs = GenerationReplicaSet(addrs, "lm", disaggregate=True,
+                                  prefix_affinity=True,
+                                  affinity_tokens=12)
+        rs.poll_load()
+        for k in range(4):  # same prefix, unique suffix, 4 requests
+            prompt = np.concatenate(
+                [prefix, rng.integers(0, 64, (2,), np.int32)])
+            toks = list(rs.generate(prompt.astype(np.int32), 5))
+            assert len(toks) == 5
+        assert rs.disagg_handoffs == 4 and rs.disagg_fallbacks == 0
+        # ALL prefills landed on the prefix's one home replica
+        counts = sorted([cbp1.prefill_dispatches,
+                         cbp2.prefill_dispatches])
+        assert counts == [0, 4], counts
+        assert cbd.prefill_dispatches == 0   # decode stayed shipped-only
+    finally:
+        if rs is not None:
+            rs.close()
+        for m in (mp1, mp2, md):
+            m.shutdown()
+        for c in (cbp1, cbp2, cbd):
+            c.shutdown()

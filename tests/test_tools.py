@@ -165,6 +165,14 @@ def test_gen_inference_pb2_schema_drift_and_roundtrip():
     assert dn.draining is True
     assert pb.StatusResponse().draining is False
 
+    # offline batch lane (tpulab.batch): the request class rides
+    # Generate — "batch" admits strictly below any online priority,
+    # from spare capacity only; absent/"" = online (unchanged)
+    bc = pb.GenerateRequest.FromString(pb.GenerateRequest(
+        prompt=[1, 2], steps=4, request_class="batch").SerializeToString())
+    assert bc.request_class == "batch"
+    assert pb.GenerateRequest().request_class == ""
+
     # debugz (tpulab.obs): the Debug unary RPC's request/response — the
     # snapshot is one JSON document (schema tpulab/obs/debugz.py), the
     # profiler fields round-trip, and zero-value defaults read as "no

@@ -384,7 +384,7 @@ def test_metrics_inventory_documented_and_disjoint():
     collectors = (M.InferenceMetrics, M.ReplicaSetMetrics,
                   M.GenerationMetrics, M.AdmissionMetrics,
                   M.KVTierMetrics, M.ModelStoreMetrics, M.HBMMetrics,
-                  M.ChaosMetrics, M.FleetMetrics)
+                  M.ChaosMetrics, M.FleetMetrics, M.BatchMetrics)
     families = {}
     for cls in collectors:
         m = cls(registry=CollectorRegistry())

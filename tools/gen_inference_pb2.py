@@ -212,6 +212,14 @@ def build_file() -> dp.FileDescriptorProto:
         # rejected (draw-order PRNG does not survive the hop); 0 = a
         # fresh request.
         field("resume_length", 17, F.TYPE_INT32),
+        # offline batch lane (tpulab.batch, docs/SERVING.md "Offline
+        # batch lane"): "" / "online" = interactive traffic (today's
+        # behavior, byte-for-byte); "batch" = preemptible bulk work that
+        # admits STRICTLY below any online priority from spare capacity
+        # only, is exempt from online tenants' DRR fair-queue
+        # accounting, and is the first preemption victim when an online
+        # arrival needs its lane or pages
+        field("request_class", 18, F.TYPE_STRING),
     ])
     m.oneof_decl.add(name="_seed")
 
@@ -339,6 +347,11 @@ def main() -> int:
         "rr = pb.GenerateRequest.FromString(rr.SerializeToString());"
         "assert rr.resume_length == 2;"
         "assert pb.GenerateRequest().resume_length == 0;"
+        "bc = pb.GenerateRequest(prompt=[1], steps=4,"
+        " request_class='batch');"
+        "bc = pb.GenerateRequest.FromString(bc.SerializeToString());"
+        "assert bc.request_class == 'batch';"
+        "assert pb.GenerateRequest().request_class == '';"
         "r2 = pb.GenerateRequest();"
         "assert not r2.HasField('seed');"
         "r2.seed = 9; assert r2.HasField('seed');"

@@ -90,6 +90,15 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
                               affinity for that request (same fallback,
                               distinct evidence): routing chaos can only
                               forgo cache warmth, never strand a request
+    batch.run                 BatchScheduler run loop (tpulab.batch), once
+                              per scheduler pass — error/drop kill the
+                              batch RUNNER mid-job: in-flight items are
+                              cancelled (their lanes free at the next tick),
+                              delivered tokens stay durable in the JSONL
+                              checkpoint sink, and the next run() resumes
+                              from delivered tokens with zero re-decode —
+                              batch chaos can cost idle-capacity soak,
+                              never online traffic or delivered work
     hbm.pressure              HBMArbiter decision sites (tpulab.hbm): one
                               trip per pressed tenant per pressure round
                               (demote-KV, evict-model) and one at the

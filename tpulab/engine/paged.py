@@ -1051,14 +1051,14 @@ class _PagedRequest:
                  "chunk_t0", "chunk_start", "kv_handle", "export_digest",
                  "draft_pages", "draft_len", "spec_enabled", "spec_ewma",
                  "spec_drafted", "spec_accepted", "spec_probe_in",
-                 "spec_probing", "tenant", "lane", "fl")
+                 "spec_probing", "tenant", "lane", "fl", "batch")
 
     def __init__(self, prompt: np.ndarray, steps: int, on_token=None,
                  sampling: Optional[SamplingParams] = None,
                  priority: int = 0, stop_tokens=None,
                  logprobs: bool = False, deadline: Optional[float] = None,
                  trace_id: Optional[str] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None, batch: bool = False):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.steps = steps
         self.future: Future = Future()
@@ -1070,6 +1070,13 @@ class _PagedRequest:
         self.cancelled = False
         self.sampling = sampling or SamplingParams()
         self.priority = priority
+        #: offline batch lane (docs/SERVING.md "Offline batch lane"):
+        #: batch requests rank strictly below EVERY online request —
+        #: they queue behind all online arrivals regardless of priority
+        #: and are the first preemption victims when an online arrival
+        #: needs a lane or pages.  Within the batch class, priority and
+        #: FIFO order apply as usual.
+        self.batch = bool(batch)
         self.resumed = False     # preempted mid-decode; resume skips the
         #                          prefill pick (its token was already emitted)
         self.kv_handle = None    # host-tier KV snapshot of a preempted lane
@@ -1503,6 +1510,10 @@ class ContinuousBatcher:
         self._active: List[Optional[_PagedRequest]] = [None] * lanes
         self._admit_counter = 0
         self.preemptions = 0
+        #: of those, evictions of BATCH-class lanes (the offline lane is
+        #: the first preemption victim by design — a high number here
+        #: with few online preemptions means the lane is doing its job)
+        self.batch_preemptions = 0
         if self.hbm is not None:
             # register as the KV tenant AFTER kv_offload is settled (the
             # reclaimable estimate reads it) and claim the page store's
@@ -1564,7 +1575,8 @@ class ContinuousBatcher:
                trace_id: Optional[str] = None,
                export_digest: Optional[bytes] = None,
                tenant: Optional[str] = None,
-               flight_owner: Optional[str] = None) -> Future:
+               flight_owner: Optional[str] = None,
+               request_class: str = "online") -> Future:
         """``on_token(token, index)`` (optional) streams tokens as they
         decode — the hook the Generate RPC rides for paged serving.
         ``sampling`` selects the token policy (default greedy).
@@ -1598,7 +1610,17 @@ class ContinuousBatcher:
         attribution (never read by the scheduler); ``flight_owner="rpc"``
         marks the wide event as assembled by the RPC layer — the engine
         still attaches its completion summary to the future
-        (``_tpulab_flight``) but does not record it itself."""
+        (``_tpulab_flight``) but does not record it itself.
+        ``request_class`` ("online" default, or "batch" — the offline
+        batch lane, docs/SERVING.md) ranks the request: a batch request
+        queues behind EVERY online request regardless of priority, is
+        the first preemption victim when an online arrival needs its
+        lane or pages, and its ``on_token`` hook (a checkpoint sink,
+        not an interactive consumer) never drags the fused-decode block
+        size down."""
+        if request_class not in ("online", "", "batch"):
+            raise ValueError(f"unknown request_class {request_class!r} "
+                             "(want 'online' or 'batch')")
         flat = np.asarray(prompt).reshape(-1)
         if isinstance(deadline, Deadline):
             deadline = deadline.expiry
@@ -1621,7 +1643,8 @@ class ContinuousBatcher:
                             sampling=sampling, priority=priority,
                             stop_tokens=stop_tokens, logprobs=logprobs,
                             deadline=deadline, trace_id=trace_id,
-                            tenant=tenant)
+                            tenant=tenant,
+                            batch=request_class == "batch")
         req.export_digest = export_digest
         if self.flight is not None or flight_owner:
             self._fl_arm(req, flight_owner)
@@ -1840,6 +1863,7 @@ class ContinuousBatcher:
         now = _time.perf_counter()
         ev: Dict[str, Any] = {
             "kind": "paged", "outcome": outcome, "tenant": req.tenant,
+            "request_class": "batch" if req.batch else "online",
             "priority": req.priority, "trace_id": req.trace_id,
             "prompt_tokens": int(len(req.prompt)), "steps": req.steps,
             "tokens": len(req.tokens_out),
@@ -1954,6 +1978,7 @@ class ContinuousBatcher:
                     "lane": lane,
                     "state": ("prefill" if req.pending_prompt
                               else "decode"),
+                    "request_class": "batch" if req.batch else "online",
                     "tenant": req.tenant, "priority": req.priority,
                     "trace_id": req.trace_id,
                     "age_s": round(now - req.t_submit, 6),
@@ -1996,6 +2021,7 @@ class ContinuousBatcher:
                          "decode_host_syncs": self.decode_host_syncs,
                          "prefill_dispatches": self.prefill_dispatches,
                          "preemptions": self.preemptions,
+                         "batch_preemptions": self.batch_preemptions,
                          "completed_requests": self.completed_requests,
                          "tokens_generated": self.tokens_generated},
             "profile_armed": profile_armed,
@@ -2016,15 +2042,25 @@ class ContinuousBatcher:
         return out
 
     # -- scheduler ----------------------------------------------------------
+    @staticmethod
+    def _rank(req: _PagedRequest):
+        """Scheduling rank: ``(class, priority)`` — every online request
+        outranks every batch request (the offline lane sits strictly
+        below online traffic at ANY priority); within a class, priority
+        orders as before."""
+        return (0 if req.batch else 1, req.priority)
+
     def _enqueue_locked(self, req: _PagedRequest,
                         front_of_class: bool) -> None:
-        """Insert by priority (higher first, FIFO within a class);
-        ``front_of_class`` puts the request ahead of its equals (preempted
-        victims resume before new same-priority arrivals)."""
+        """Insert by rank (online before batch, higher priority first,
+        FIFO within a class); ``front_of_class`` puts the request ahead
+        of its equals (preempted victims resume before new same-priority
+        arrivals)."""
+        rank = self._rank(req)
         i = 0
         for i, q in enumerate(self._queue):
-            if (q.priority < req.priority
-                    or (front_of_class and q.priority == req.priority)):
+            if (self._rank(q) < rank
+                    or (front_of_class and self._rank(q) == rank)):
                 self._queue.insert(i, req)
                 return
         self._queue.append(req)
@@ -2274,24 +2310,28 @@ class ContinuousBatcher:
                     if not self._admit_to_lane_locked(lane):
                         break
         # preemption: while the queue head strictly outranks the weakest
-        # active request (priority tie-break: most recently admitted falls
-        # first — least progress lost), evict it and admit the head.
-        # Zero-page lanes (page-starved prefills) are skipped: evicting
-        # them frees nothing and they already yield every tick.
+        # active request (rank = (class, priority): BATCH lanes are the
+        # first victims — any online arrival evicts batch work before
+        # touching another online lane; within a class the priority
+        # tie-break stays most-recently-admitted falls first — least
+        # progress lost), evict it and admit the head.  Zero-page lanes
+        # (page-starved prefills) are skipped: evicting them frees
+        # nothing and they already yield every tick.
         while self._queue:
             head = self._queue[0]
+            head_rank = self._rank(head)
             # a victim only helps if releasing it can actually free a page:
             # skip lanes whose every page is prefix-cache-shared
             # (refcount > 1) — preempting them loses decode progress for
             # zero freed pages
-            victims = [(req.priority, -req.admit_seq, lane)
+            victims = [(self._rank(req) + (-req.admit_seq, lane))
                        for lane, req in enumerate(self._active)
-                       if req is not None and req.priority < head.priority
+                       if req is not None and self._rank(req) < head_rank
                        and any(self.pool.refcount(p) == 1
                                for p in req.pages)]
             if not victims:
                 return
-            _, _, lane = min(victims)
+            lane = min(victims)[-1]
             self._preempt_locked(lane)
             if not self._admit_to_lane_locked(lane):
                 # Defensive: the victim filter above requires at least one
@@ -2346,6 +2386,8 @@ class ContinuousBatcher:
         self._active[lane] = None
         self._enqueue_locked(req, front_of_class=True)
         self.preemptions += 1
+        if req.batch:
+            self.batch_preemptions += 1
 
     def _run(self) -> None:
         import jax.numpy as jnp
@@ -2749,7 +2791,10 @@ class ContinuousBatcher:
             if (req.deadline is not None
                     and req.deadline - now < self._tight_slack_s()):
                 want = min(want, 2)
-            if req.on_token is not None:
+            if req.on_token is not None and not req.batch:
+                # batch lanes run throughput-optimized: their on_token
+                # hook is a durable checkpoint sink, not an interactive
+                # consumer — never let it drag the whole block to K<=2
                 streaming = True
             max_rem = max(max_rem, req.steps - len(req.tokens_out))
         if streaming and not self._queue:

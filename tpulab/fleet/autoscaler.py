@@ -159,7 +159,8 @@ class FleetAutoscaler:
                  up_overloads: int = 1,
                  min_replicas: int = 1, max_replicas: int = 8,
                  hold: int = 2, cooldown_s: float = 0.0,
-                 drain_timeout_s: float = 30.0, metrics=None):
+                 drain_timeout_s: float = 30.0, metrics=None,
+                 batch_drain: Optional[Callable[[str], None]] = None):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
         if max_replicas < min_replicas:
@@ -176,6 +177,16 @@ class FleetAutoscaler:
         self.cooldown_s = float(cooldown_s)
         self.drain_timeout_s = float(drain_timeout_s)
         self._metrics = metrics
+        #: offline batch lane hook (tpulab.batch, docs/SERVING.md
+        #: "Offline batch lane"): called with the victim address the
+        #: moment a scale-down drain starts, BEFORE the provider drain —
+        #: batch work drains FIRST (the scheduler stops feeding and
+        #: cancels its preemptible in-flight items, whose delivered
+        #: tokens are already checkpointed), so the drain only waits on
+        #: online streams.  Note the autoscaler already IGNORES batch
+        #: pressure by construction: its wait signal is the admission
+        #: queue-wait EWMA, which batch-class admissions never feed.
+        self._batch_drain = batch_drain
         self._lock = threading.Lock()
         self._up_streak = 0
         self._down_streak = 0
@@ -274,6 +285,15 @@ class FleetAutoscaler:
         # routing first: no router-side pick may land on the victim from
         # this instant; the HRW ring re-ranks around it (ring_moves)
         self._rs.set_draining(victim, True)
+        if self._batch_drain is not None:
+            # batch drains first: preemptible work yields its lanes now
+            # (delivered tokens are durable; the job resumes elsewhere/
+            # later), so the provider drain below only waits on online
+            # streams
+            try:
+                self._batch_drain(victim)
+            except Exception:  # pragma: no cover - hook must not block
+                log.exception("batch_drain hook failed for %s", victim)
         self.drains += 1
         m = self._metrics
         if m is not None:

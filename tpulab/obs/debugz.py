@@ -97,6 +97,13 @@ def debug_snapshot(resources=None, *, generation_engines=None,
             snap["admission"] = {
                 "inflight": admission.inflight,
                 "queue_depth": admission.queue_depth,
+                # offline batch lane: its waiters ride their own queue
+                # (never an online queue slot) — reported separately,
+                # and batch tenants appear as "batch:<tenant>" below
+                "batch_queue_depth": getattr(admission,
+                                             "batch_queue_depth", 0),
+                "batch_admitted_total": getattr(admission,
+                                                "batch_admitted_total", 0),
                 "queue_depths_by_tenant": admission.queue_depths(),
                 "model_inflight": dict(admission.model_inflight),
                 "admitted_total": admission.admitted_total,

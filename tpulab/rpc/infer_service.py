@@ -745,6 +745,8 @@ class GenerateContext(StreamingContext):
             ev["resume_length"] = int(request.resume_length)
         if request.prefill_only:
             ev["prefill_only"] = True
+        if request.request_class == "batch":
+            ev["request_class"] = "batch"
         if res.hbm is not None:
             ev["_hbm0"] = int(res.hbm.pressure_events)
         final, delivered = ev["_final"], ev["_delivered"]
@@ -869,6 +871,22 @@ class GenerateContext(StreamingContext):
                 code=pb.INVALID_ARGUMENT,
                 message=f"prompt token ids outside [0, {vocab})")))
             return
+        if request.request_class not in ("", "online", "batch"):
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT,
+                message=f"unknown request_class "
+                        f"{request.request_class!r} (want 'online' or "
+                        "'batch')")))
+            return
+        if (request.request_class == "batch"
+                and (request.prefill_only or request.kv_shipment)):
+            # the offline lane is a whole-request class: a disaggregated
+            # hop is online serving machinery and carries no class
+            self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
+                code=pb.INVALID_ARGUMENT,
+                message="request_class='batch' cannot combine with "
+                        "prefill_only/kv_shipment")))
+            return
         msg = self._validate_resume(request)
         if msg is not None:
             self.write(pb.GenerateResponse(final=True, status=pb.RequestStatus(
@@ -987,7 +1005,8 @@ class GenerateContext(StreamingContext):
                 cost=cost,
                 priority=request.priority, deadline=deadline,
                 trace_id=tc.trace_id if tc is not None else None,
-                model=request.model_name)
+                model=request.model_name,
+                request_class=request.request_class or "online")
             # wide event: the admission verdict + queue wait + the
             # tenant's DRR deficit at dispatch (tpulab.obs)
             self._fl_note(admission={
@@ -1289,6 +1308,11 @@ class GenerateContext(StreamingContext):
             if tc is not None:
                 # same gating: only traced requests carry the kwarg
                 kw["trace_id"] = tc.trace_id
+            if request.request_class == "batch":
+                # offline batch lane: the engine ranks this lane below
+                # every online request and preempts it first.  Gated so
+                # wrapped/test engines without the kwarg keep working.
+                kw["request_class"] = "batch"
             if request.kv_shipment and not request.return_logprobs:
                 # shipped-KV admit: import into the local host tier and
                 # promote through the restore path — zero prefill
@@ -1452,6 +1476,7 @@ class GenerateStreamClient:
                  kv_shipment: Optional[bytes] = None,
                  prefill_only: bool = False,
                  resume_length: int = 0,
+                 request_class: str = "",
                  ttft_timeout: Optional[float] = None,
                  inter_token_timeout: Optional[float] = None,
                  _cancel_evt=None,
@@ -1539,6 +1564,11 @@ class GenerateStreamClient:
             req.prefill_only = True
         if resume_length:
             req.resume_length = int(resume_length)
+        if request_class:
+            # offline batch lane (docs/SERVING.md "Offline batch lane"):
+            # "batch" admits strictly below any online priority, from
+            # spare capacity only, and is the first preemption victim
+            req.request_class = request_class
         rem = deadline.remaining()
         if rem is not None:
             # RELATIVE budget, never wall clock: replica clocks differ

@@ -1566,9 +1566,20 @@ class GenerationReplicaSet(_BaseReplicaSet):
         if not prefills or not decodes:
             yield from fallback(0)
             return
-        # -- hop 1: prefill + export ----------------------------------------
+        # -- hop 1: prefill + export.  With affinity on, the prefill-side
+        # pick rendezvous-ranks WITHIN the prefill role — the same
+        # treatment decode handoffs already get — so a returning
+        # prefix's prompt KV (prefix-cache pages, host-tier demotions)
+        # stays warm on ONE prefill replica instead of scattering; a
+        # load-only pick would pay a cold prefill per replica before
+        # the prefill side of the fleet warms (ROADMAP item 1
+        # follow-up (b))
         first = blob = None
-        idx = self._pick(frozenset(range(len(self._managers))) - prefills)
+        idx = (self._pick_affine(prompt, frozenset(),
+                                 allowed=frozenset(prefills))
+               if self.prefix_affinity
+               else self._pick(frozenset(range(len(self._managers)))
+                               - prefills))
         if idx is not None:
             t_att = time.perf_counter()
             try:

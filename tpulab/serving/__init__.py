@@ -7,6 +7,8 @@ before it consumes a lane, KV pages, or a session lease.
 """
 
 from tpulab.serving.admission import (DEFAULT_TENANT,  # noqa: F401
+                                      REQUEST_CLASS_BATCH,
+                                      REQUEST_CLASS_ONLINE, REQUEST_CLASSES,
                                       TENANT_METADATA_KEY, AdmissionConfig,
                                       AdmissionController, AdmissionRejected,
                                       AdmissionTicket, TokenBucket,

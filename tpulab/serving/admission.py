@@ -62,6 +62,18 @@ TENANT_METADATA_KEY = "tpulab-tenant"
 #: tenant label for requests that carry no identity
 DEFAULT_TENANT = "default"
 
+#: request classes (the offline batch lane, docs/SERVING.md "Offline
+#: batch lane").  ONLINE is interactive traffic — today's behavior,
+#: unchanged.  BATCH is preemptible bulk work (scoring, evals,
+#: distillation traces) that admits STRICTLY below any online priority:
+#: it dispatches only when no online request waits, rides its own
+#: fair queue so a batch flood never moves an online tenant's DRR
+#: deficit, and is excluded from the queue-wait EWMA the fleet
+#: autoscaler scales on (preemptible work must never buy replicas).
+REQUEST_CLASS_ONLINE = "online"
+REQUEST_CLASS_BATCH = "batch"
+REQUEST_CLASSES = (REQUEST_CLASS_ONLINE, REQUEST_CLASS_BATCH)
+
 #: rejection reasons (the ``reason`` label on AdmissionMetrics.rejected)
 REJECT_REASONS = ("global_rate", "tenant_rate", "queue_full", "shed",
                   "deadline", "queue_timeout", "chaos")
@@ -131,6 +143,15 @@ class AdmissionConfig:
     #: priority (shedding order + queue ranking): a latency-critical
     #: model's traffic outranks a batch model's at overflow
     model_priorities: Optional[Dict[str, int]] = None
+    #: bound on WAITING batch-class admissions (the batch lane's own
+    #: fair queue, never shared with online waiters); None = the online
+    #: ``max_queue_depth`` value
+    max_batch_queue_depth: Optional[int] = None
+    #: arbiter headroom floor for batch dispatch: with an HBM arbiter
+    #: armed, batch work only dispatches while ``free_hbm_bytes`` stays
+    #: at or above this — spare capacity means ACTUALLY spare, not
+    #: bytes a pressure round is about to hand to an online tenant
+    batch_min_free_hbm_bytes: int = 0
 
 
 class TokenBucket:
@@ -172,14 +193,18 @@ class AdmissionTicket:
     exit) returns it and dispatches the next queued admission."""
 
     __slots__ = ("tenant", "cost", "model", "queue_wait_s", "drr_deficit",
-                 "_ctrl", "_t_admit", "_released")
+                 "request_class", "_ctrl", "_t_admit", "_released")
 
     def __init__(self, ctrl: "AdmissionController", tenant: str, cost: int,
                  queue_wait_s: float, model: str = "",
-                 drr_deficit: float = 0.0):
+                 drr_deficit: float = 0.0,
+                 request_class: str = REQUEST_CLASS_ONLINE):
         self.tenant = tenant
         self.cost = cost
         self.model = model
+        #: "online" or "batch" (REQUEST_CLASSES) — batch tickets never
+        #: feed the queue-wait EWMA the autoscaler scales on
+        self.request_class = request_class
         self.queue_wait_s = queue_wait_s
         #: the tenant's deficit-round-robin credit at dispatch (0.0 on
         #: the no-queue fast path) — a wide event (tpulab.obs) records it
@@ -206,13 +231,15 @@ class _Waiter:
     """A queued admission request (entry in the DRR queue)."""
 
     __slots__ = ("tenant", "cost", "model", "priority", "deadline", "seq",
-                 "event", "ticket", "reject", "t_enqueue")
+                 "event", "ticket", "reject", "t_enqueue", "request_class")
 
     def __init__(self, tenant: str, cost: int, priority: int,
-                 deadline: Optional[Deadline], seq: int, model: str = ""):
+                 deadline: Optional[Deadline], seq: int, model: str = "",
+                 request_class: str = REQUEST_CLASS_ONLINE):
         self.tenant = tenant
         self.cost = cost
         self.model = model
+        self.request_class = request_class
         self.priority = priority
         self.deadline = deadline
         self.seq = seq
@@ -257,6 +284,11 @@ class AdmissionController:
         cfg = self.config
         self._lock = threading.Lock()
         self._queue = DeficitRoundRobinQueue(quantum=cfg.drr_quantum)
+        #: batch-class waiters ride their OWN fair queue (docs/SERVING.md
+        #: "Offline batch lane"): a batch flood must not occupy online
+        #: queue slots or move any online tenant's DRR deficit, and batch
+        #: dispatch happens only when no online waiter remains
+        self._batch_queue = DeficitRoundRobinQueue(quantum=cfg.drr_quantum)
         self._inflight = 0
         self._seq = 0
         self._global_bucket = (TokenBucket(cfg.global_rate, cfg.global_burst)
@@ -273,6 +305,7 @@ class AdmissionController:
         self._queue_wait_ewma: Optional[float] = None
         # -- observability (test-assertable without prometheus) -------------
         self.admitted_total = 0
+        self.batch_admitted_total = 0
         self.rejected_total = 0
         self.shed_total = 0
         self.rejected_by_reason: Dict[str, int] = {}
@@ -284,8 +317,17 @@ class AdmissionController:
     # -- load signals --------------------------------------------------------
     @property
     def queue_depth(self) -> int:
+        """ONLINE waiters only: queued batch work is preemptible filler
+        that yields its capacity within one tick, so it must not make
+        this replica look loaded to routers (or to the autoscaler)."""
         with self._lock:
             return len(self._queue)
+
+    @property
+    def batch_queue_depth(self) -> int:
+        """Waiting batch-class admissions (the offline lane's backlog)."""
+        with self._lock:
+            return len(self._batch_queue)
 
     @property
     def inflight(self) -> int:
@@ -293,16 +335,24 @@ class AdmissionController:
             return self._inflight
 
     def queue_depths(self) -> Dict[str, int]:
-        """Queued admissions per tenant (the debugz live view)."""
+        """Queued admissions per tenant (the debugz live view); batch
+        tenants are namespaced ``batch:<tenant>`` — their waiters live
+        in the offline lane's own fair queue."""
         with self._lock:
-            return self._queue.depths()
+            depths = self._queue.depths()
+            for t, n in self._batch_queue.depths().items():
+                depths[f"batch:{t}"] = n
+            return depths
 
     @property
     def queue_wait_ewma_s(self) -> float:
-        """EWMA of the queue wait admitted requests actually paid
+        """EWMA of the queue wait admitted ONLINE requests actually paid
         (seconds; 0.0 before any admission) — the load signal the fleet
         autoscaler scales on: waiting requests mean the fleet is short a
-        replica long before anything is rejected."""
+        replica long before anything is rejected.  Batch-class
+        admissions are excluded by construction: the offline lane waits
+        for spare capacity on purpose, and preemptible filler must
+        never look like demand worth buying a replica for."""
         with self._lock:
             return self._queue_wait_ewma or 0.0
 
@@ -380,6 +430,48 @@ class AdmissionController:
             return True
         return True
 
+    def headroom_ok(self, cost: int, model: str = "") -> bool:
+        """Public view of the cost-aware dispatch gate — the ONE unified
+        headroom admission itself consults (free pool pages, demotable
+        KV, arbiter free + reclaimable bytes).  The batch lane's
+        spare-capacity probe (tpulab.batch.BatchScheduler) reads it here
+        instead of re-deriving its own optimistic estimate."""
+        with self._lock:
+            return self._capacity_ok_locked(max(1, int(cost)), model)
+
+    def _batch_spare_locked(self, cost: int, model: str = "") -> bool:
+        """Spare-capacity gate for batch-class dispatch (docs/SERVING.md
+        "Offline batch lane"): no online waiter may remain (batch sits
+        strictly below any online priority), the engine must have an
+        IDLE lane (batch never queues inside the engine where it could
+        delay an online admit), the unified headroom must cover the
+        cost, and with an arbiter armed ``free_hbm_bytes`` must sit at
+        or above the configured floor — spare means actually spare, not
+        bytes a pressure round is about to hand to an online tenant."""
+        if len(self._queue):
+            return False
+        if not self._capacity_ok_locked(cost, model):
+            return False
+        eng = self._load
+        if eng is not None:
+            try:
+                lanes = int(getattr(eng, "lanes", 0) or 0)
+                if lanes and (int(getattr(eng, "active_lanes", 0)) >= lanes
+                              or int(getattr(eng, "queued_requests", 0))
+                              > 0):
+                    return False
+            except Exception:  # torn-down engine: the capacity gate ruled
+                pass
+        arb = self.hbm
+        floor = int(self.config.batch_min_free_hbm_bytes)
+        if arb is not None and floor > 0:
+            try:
+                if int(arb.free_hbm_bytes) < floor:
+                    return False
+            except Exception:  # torn-down arbiter must not wedge batch
+                pass
+        return True
+
     # -- estimators ----------------------------------------------------------
     def _predicted_wait_locked(self, position: int) -> float:
         """Expected queue wait at ``position`` (0 = head): EWMA service
@@ -400,18 +492,30 @@ class AdmissionController:
     def admit(self, tenant: str = "", cost: int = 1, priority: int = 0,
               deadline: Optional[Deadline] = None,
               trace_id: Optional[str] = None,
-              model: str = "") -> AdmissionTicket:
+              model: str = "",
+              request_class: str = REQUEST_CLASS_ONLINE
+              ) -> AdmissionTicket:
         """Admit (possibly after a bounded fair-queue wait) or raise
         :class:`AdmissionRejected`.  ``cost`` is estimated tokens
         (prompt + steps) for generation, batch size for dense inference.
         ``model`` arms the per-model dimension (multi-model serving):
         the configured per-model cost multiplier and priority boost
         apply, the modelstore residency gate is consulted, and the
-        request counts in :attr:`model_inflight`.  The returned ticket
-        MUST be released when the request finishes (context manager)."""
+        request counts in :attr:`model_inflight`.  ``request_class``
+        (:data:`REQUEST_CLASSES`; ""/"online" = interactive) marks the
+        offline batch lane: batch admissions dispatch strictly below any
+        online work, from spare capacity only, ride their own fair
+        queue (a batch flood never moves an online tenant's DRR
+        deficit) and never feed the queue-wait EWMA the fleet
+        autoscaler scales on.  The returned ticket MUST be released when
+        the request finishes (context manager)."""
         t0 = time.perf_counter()
         tenant = tenant or DEFAULT_TENANT
         cost = max(1, int(cost))
+        request_class = request_class or REQUEST_CLASS_ONLINE
+        if request_class not in REQUEST_CLASSES:
+            raise ValueError(f"unknown request_class {request_class!r} "
+                             f"(want one of {REQUEST_CLASSES})")
         cfg = self.config
         if model:
             if cfg.model_costs:
@@ -430,7 +534,8 @@ class AdmissionController:
                     "chaos", f"admission chaos: {e}",
                     retry_after_ms=self.config.min_retry_after_ms)
             ticket, waiter = self._admit_or_enqueue(tenant, cost, priority,
-                                                    deadline, model)
+                                                    deadline, model,
+                                                    request_class)
             if ticket is None:  # queued: wait for dispatch/shed/expiry
                 ticket = self._wait(waiter, deadline)
         except AdmissionRejected as e:
@@ -440,8 +545,10 @@ class AdmissionController:
         return ticket
 
     def _admit_or_enqueue(self, tenant: str, cost: int, priority: int,
-                          deadline: Optional[Deadline], model: str = ""):
+                          deadline: Optional[Deadline], model: str = "",
+                          request_class: str = REQUEST_CLASS_ONLINE):
         cfg = self.config
+        batch = request_class == REQUEST_CLASS_BATCH
         with self._lock:
             # 1) rate limits fail fast — a bucket that says "not now" must
             # not convert rate limiting into queueing
@@ -466,19 +573,34 @@ class AdmissionController:
                         f"tenant {tenant!r} request rate exceeded",
                         retry_after_ms=max(cfg.min_retry_after_ms,
                                            int(tb.retry_after_s() * 1e3)))
-            # 2) fast path: capacity now, nobody queued ahead
-            if (self._inflight < cfg.max_inflight and not len(self._queue)
+            # 2) fast path: capacity now, nobody queued ahead.  Batch
+            # arrivals additionally clear the spare-capacity gate (idle
+            # lane, unified headroom, arbiter floor) — the offline lane
+            # soaks what online traffic is not using, never more
+            if batch:
+                if (self._inflight < cfg.max_inflight
+                        and not len(self._batch_queue)
+                        and self._batch_spare_locked(cost, model)):
+                    self._inflight += 1
+                    self.model_inflight[model] = (
+                        self.model_inflight.get(model, 0) + 1)
+                    self._note_pressure_locked()
+                    return AdmissionTicket(
+                        self, tenant, cost, 0.0, model,
+                        request_class=REQUEST_CLASS_BATCH), None
+            elif (self._inflight < cfg.max_inflight and not len(self._queue)
                     and self._capacity_ok_locked(cost, model)):
                 self._inflight += 1
                 self.model_inflight[model] = (
                     self.model_inflight.get(model, 0) + 1)
                 self._note_pressure_locked()
                 return AdmissionTicket(self, tenant, cost, 0.0, model), None
+            q = self._batch_queue if batch else self._queue
             # 3) deadline-aware early rejection: don't queue a request
             # that cannot finish in time
             if deadline is not None:
                 rem = deadline.remaining()
-                predicted = self._predicted_wait_locked(len(self._queue))
+                predicted = self._predicted_wait_locked(len(q))
                 if rem is not None and predicted > 0 and rem < predicted:
                     raise AdmissionRejected(
                         "deadline",
@@ -486,30 +608,43 @@ class AdmissionController:
                         f"exceeds remaining deadline {rem * 1e3:.0f}ms",
                         retry_after_ms=min(cfg.max_retry_after_ms,
                                            int(predicted * 1e3)))
-            # 4) bounded queue with lowest-priority-first shedding
-            if len(self._queue) >= cfg.max_queue_depth:
-                victim = self._queue.peek_lowest_priority()
+            # 4) bounded queue with lowest-priority-first shedding.  Each
+            # class sheds only within itself: a batch arrival can never
+            # displace an online waiter, and an online overflow never
+            # needs to — batch waiters occupy no online queue slot
+            depth_cap = (cfg.max_batch_queue_depth
+                         if batch and cfg.max_batch_queue_depth is not None
+                         else cfg.max_queue_depth)
+            if len(q) >= depth_cap:
+                victim = q.peek_lowest_priority()
                 if victim is None or victim.priority >= priority:
                     raise AdmissionRejected(
                         "queue_full",
-                        f"admission queue full "
-                        f"(depth {len(self._queue)})",
+                        f"admission {'batch ' if batch else ''}queue full "
+                        f"(depth {len(q)})",
                         retry_after_ms=self._retry_hint_ms_locked())
-                self._queue.remove(victim)
+                q.remove(victim)
                 victim.reject = AdmissionRejected(
                     "shed",
                     f"shed for a priority-{priority} request "
                     f"(own priority {victim.priority})",
                     retry_after_ms=self._retry_hint_ms_locked())
                 victim.event.set()
-            # 5) deficit-round-robin fair queue
+            # 5) deficit-round-robin fair queue (per class)
             self._seq += 1
-            w = _Waiter(tenant, cost, priority, deadline, self._seq, model)
-            self._queue.push(w)
-            self.peak_queue_depth = max(self.peak_queue_depth,
-                                        len(self._queue))
+            w = _Waiter(tenant, cost, priority, deadline, self._seq, model,
+                        request_class=request_class)
+            q.push(w)
+            if not batch:
+                self.peak_queue_depth = max(self.peak_queue_depth,
+                                            len(self._queue))
             self._note_pressure_locked()
             return None, w
+
+    def _wq(self, w: _Waiter) -> DeficitRoundRobinQueue:
+        """The fair queue holding this waiter (per request class)."""
+        return (self._batch_queue if w.request_class == REQUEST_CLASS_BATCH
+                else self._queue)
 
     def _wait(self, w: _Waiter, deadline: Optional[Deadline]
               ) -> AdmissionTicket:
@@ -531,13 +666,13 @@ class AdmissionController:
                 if w.reject is not None:
                     raise w.reject
                 if deadline is not None and deadline.expired():
-                    self._queue.remove(w)
+                    self._wq(w).remove(w)
                     self._note_pressure_locked()
                     raise AdmissionRejected(
                         "deadline", "deadline expired while queued",
                         retry_after_ms=0)
                 if time.monotonic() >= end:
-                    self._queue.remove(w)
+                    self._wq(w).remove(w)
                     self._note_pressure_locked()
                     raise AdmissionRejected(
                         "queue_timeout",
@@ -549,7 +684,10 @@ class AdmissionController:
     def _dispatch_locked(self) -> None:
         """Move queued waiters into inflight while capacity allows, in
         DRR order.  A waiter the pool cannot hold yet goes back to the
-        head (pages free continuously; the fairness charge is refunded)."""
+        head (pages free continuously; the fairness charge is refunded).
+        Batch-class waiters dispatch ONLY once no online waiter remains
+        — and only into spare capacity — so the offline lane sits
+        strictly below every online priority without sharing a queue."""
         while self._inflight < self.config.max_inflight and len(self._queue):
             w = self._queue.pop()
             if w.deadline is not None and w.deadline.expired():
@@ -568,6 +706,27 @@ class AdmissionController:
                 self, w.tenant, w.cost,
                 time.perf_counter() - w.t_enqueue, w.model,
                 drr_deficit=self._queue.deficit_of(w.tenant))
+            w.event.set()
+        while (self._inflight < self.config.max_inflight
+               and len(self._batch_queue) and not len(self._queue)):
+            w = self._batch_queue.pop()
+            if w.deadline is not None and w.deadline.expired():
+                w.reject = AdmissionRejected(
+                    "deadline", "deadline expired while queued",
+                    retry_after_ms=0)
+                w.event.set()
+                continue
+            if not self._batch_spare_locked(w.cost, w.model):
+                self._batch_queue.requeue_front(w, refund=w.cost)
+                break
+            self._inflight += 1
+            self.model_inflight[w.model] = (
+                self.model_inflight.get(w.model, 0) + 1)
+            w.ticket = AdmissionTicket(
+                self, w.tenant, w.cost,
+                time.perf_counter() - w.t_enqueue, w.model,
+                drr_deficit=self._batch_queue.deficit_of(w.tenant),
+                request_class=REQUEST_CLASS_BATCH)
             w.event.set()
 
     def _on_release(self, ticket: AdmissionTicket) -> None:
@@ -595,10 +754,17 @@ class AdmissionController:
                        t0: float, trace_id: Optional[str]) -> None:
         with self._lock:
             self.admitted_total += 1
-            w = ticket.queue_wait_s
-            self._queue_wait_ewma = (w if self._queue_wait_ewma is None
-                                     else 0.8 * self._queue_wait_ewma
-                                     + 0.2 * w)
+            if ticket.request_class == REQUEST_CLASS_BATCH:
+                # the offline lane NEVER feeds the queue-wait EWMA: the
+                # fleet autoscaler scales on it, and preemptible filler
+                # waiting for spare capacity must not buy replicas
+                # (docs/SERVING.md "Offline batch lane")
+                self.batch_admitted_total += 1
+            else:
+                w = ticket.queue_wait_s
+                self._queue_wait_ewma = (w if self._queue_wait_ewma is None
+                                         else 0.8 * self._queue_wait_ewma
+                                         + 0.2 * w)
         if self._metrics is not None:
             self._metrics.note_admitted(tenant, ticket.queue_wait_s)
         if self.trace is not None:

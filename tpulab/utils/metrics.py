@@ -400,6 +400,92 @@ class FleetMetrics:
         self.queue_wait.set(max(0.0, float(seconds)))
 
 
+class BatchMetrics:
+    """Offline-batch-lane telemetry (`_batch_*`; tpulab.batch,
+    docs/SERVING.md "Offline batch lane"): job/item progress, how often
+    online traffic evicted the lane, tokens delivered vs re-decode the
+    checkpoint resume avoided, and the utilization-soak gauge — is the
+    lane actually converting idle capacity into tokens.  Sampled by
+    ``poll(scheduler)`` (cheap attribute reads; counters advance by the
+    delta since the last poll, so rate() works in PromQL)."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.jobs_running = Gauge(
+            f"{ns}_batch_jobs_running",
+            "Batch jobs a scheduler is currently running",
+            registry=self.registry)
+        self.jobs_done = Counter(
+            f"{ns}_batch_jobs_done_total",
+            "Batch jobs run to completion (every item done)",
+            registry=self.registry)
+        self.jobs_interrupted = Counter(
+            f"{ns}_batch_jobs_interrupted_total",
+            "Batch runs killed mid-job (chaos/timeout); the next run "
+            "resumes from the JSONL checkpoint", registry=self.registry)
+        self.items_done = Counter(
+            f"{ns}_batch_items_done_total",
+            "Job items (prompts) completed", registry=self.registry)
+        self.preemptions = Counter(
+            f"{ns}_batch_preemptions_total",
+            "Batch-class lanes evicted by online arrivals (the lane is "
+            "the FIRST preemption victim by design — a high count with "
+            "healthy online latencies is the lane working)",
+            registry=self.registry)
+        self.tokens_delivered = Counter(
+            f"{ns}_batch_tokens_delivered_total",
+            "Tokens delivered to batch result sinks",
+            registry=self.registry)
+        self.tokens_replay_avoided = Counter(
+            f"{ns}_batch_tokens_replay_avoided_total",
+            "Delivered tokens a checkpoint resume did NOT re-decode "
+            "(the prompt+delivered prefix rode one chunked prefill)",
+            registry=self.registry)
+        self.spare_denials = Counter(
+            f"{ns}_batch_spare_denials_total",
+            "Feed attempts deferred by the spare-capacity gate (idle "
+            "lanes / unified headroom / arbiter floor)",
+            registry=self.registry)
+        self.soak_utilization = Gauge(
+            f"{ns}_batch_soak_utilization",
+            "Fraction of engine lanes the batch lane occupies right now "
+            "(near 1 on an idle fleet, near 0 under online load — both "
+            "are the lane working as designed)", registry=self.registry)
+        self._last: Dict[str, int] = {}
+
+    def _advance(self, counter, key: str, value: int) -> None:
+        delta = value - self._last.get(key, 0)
+        if delta > 0:
+            counter.inc(delta)
+        self._last[key] = value
+
+    def poll(self, scheduler) -> None:
+        """Sample a tpulab.batch.BatchScheduler (control-loop hook)."""
+        self.jobs_running.set(getattr(scheduler, "jobs_running", 0))
+        self.soak_utilization.set(
+            getattr(scheduler, "soak_utilization", 0.0))
+        self._advance(self.jobs_done, "jobs_done",
+                      getattr(scheduler, "jobs_done", 0))
+        self._advance(self.jobs_interrupted, "interrupted",
+                      getattr(scheduler, "interrupted_runs", 0))
+        self._advance(self.items_done, "items_done",
+                      getattr(scheduler, "items_done", 0))
+        self._advance(self.tokens_delivered, "tokens",
+                      getattr(scheduler, "tokens_delivered", 0))
+        self._advance(self.tokens_replay_avoided, "replay_avoided",
+                      getattr(scheduler, "tokens_resume_skipped", 0))
+        self._advance(self.spare_denials, "spare_denials",
+                      getattr(scheduler, "spare_denials", 0))
+        eng = getattr(scheduler, "engine", None)
+        if eng is not None:
+            self._advance(self.preemptions, "preemptions",
+                          getattr(eng, "batch_preemptions", 0))
+
+
 class GenerationMetrics:
     """LLM-serving observability for a ContinuousBatcher: lane/queue/page
     gauges plus token/request/preemption/prefix-cache counters.  Sampled
