@@ -1225,6 +1225,22 @@ def main() -> None:
         except Exception as e:
             print(f"# sharded decode row skipped: {e!r}", file=sys.stderr)
 
+    # ragged dispatch plan (docs/PERFORMANCE.md "Ragged paged
+    # attention"): one fused mixed prefill+decode program vs the legacy
+    # split dispatch across batch-raggedness shapes.  On the CPU capture
+    # path the dispatch/host-sync folding and token parity are the
+    # signal (the pallas kernel runs in interpret mode there — its
+    # tok/s measures the interpreter, so the kernel mode is skipped off
+    # TPU); on-device the kernel mode's tok/s is.
+    if not degraded:
+        try:
+            _phase("ragged_attention")
+            from tpulab.engine.paged import benchmark_ragged_attention
+            _record(ragged_attention=benchmark_ragged_attention(
+                kernel=on_tpu))
+        except Exception as e:
+            print(f"# ragged attention row skipped: {e!r}", file=sys.stderr)
+
     _phase("emit")
     with _state_lock:
         _state["done"] = True

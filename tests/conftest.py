@@ -16,6 +16,13 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 from tpulab.tpu.platform import force_cpu  # noqa: E402
 
 force_cpu(8)
+# NOTE: the persistent XLA compilation cache is deliberately NOT enabled
+# here — jaxlib 0.4.37's CPU cache path SIGBUS/aborts on some
+# multi-device programs (reproducible via test_train_checkpoint_resume_
+# exact with jax_compilation_cache_dir set).  In-process compile reuse
+# for the serving engine comes from ContinuousBatcher's program memo
+# (engine/paged.py _JIT_MEMO) instead, which shares jitted programs
+# across identical-geometry engines without any serialization.
 
 
 def free_port() -> int:

@@ -341,6 +341,7 @@ def test_engine_uses_native_pools_when_built(monkeypatch):
         mgr.shutdown()
 
 
+@pytest.mark.slow
 def test_w8a8_resnet_serves_through_full_pipeline():
     """VERDICT r3 #9: the calibrated full-INT8 model as a SERVABLE model —
     registration (compile), pipeline staging, runner, and sane outputs vs

@@ -571,6 +571,7 @@ def test_kv_cache_quantization_fp8(lm):
     assert corr > 0.98, corr
 
 
+@pytest.mark.slow
 def test_scheduler_churn_soak(lm):
     """Priorities, preemption, prefix sharing, cancels, and page pressure
     all at once: every surviving request must return EXACTLY its

@@ -1,0 +1,402 @@
+"""Ragged paged-attention kernel family + unified dispatch plan.
+
+Two layers of drift guard (ROADMAP item 2, docs/PERFORMANCE.md "Ragged
+paged attention"):
+
+1. Kernel grid — :func:`tpulab.ops.ragged_attention.ragged_paged_attention`
+   against a dense per-lane reference, parametrized over dtype
+   (f32/bf16) x page size x raggedness shape (all-decode, all-prefill,
+   mixed, K+1 verify, page-boundary crossings) x mesh {None,
+   {"model": 2}} on the 8-fake-CPU-device harness (pallas interpret
+   mode: tier-1 exercises the real kernel path).
+
+2. Engine parity — ContinuousBatcher token streams, ragged plan
+   (kernel and XLA-gather attention, mesh on and off) bit-identical to
+   the legacy split dispatch for greedy / device-sampled / logprobs /
+   host-sampled / speculative requests, with the mixed
+   prefill+decode round running as ONE fused dispatch (host-sync count
+   guard, the PR 8 discipline).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpulab.engine.paged import ContinuousBatcher, SamplingParams
+from tpulab.models.transformer import (early_exit_draft,
+                                       init_transformer_params)
+from tpulab.ops.ragged_attention import ragged_paged_attention
+from tpulab.parallel import make_mesh
+
+# ------------------------------------------------------------ kernel ----
+
+
+def _reference(q, k_pool, v_pool, tables, q_lens, kv_lens):
+    """Dense per-lane reference (f32 numpy): query j of lane b sits at
+    position kv_lens[b] - q_lens[b] + j and attends positions <= it."""
+    b, m, h, d = q.shape
+    hkv = k_pool.shape[2]
+    g = h // hkv
+    out = np.zeros(q.shape, np.float32)
+    for bb in range(b):
+        k_ctx = np.asarray(k_pool[tables[bb]], np.float32).reshape(-1, hkv, d)
+        v_ctx = np.asarray(v_pool[tables[bb]], np.float32).reshape(-1, hkv, d)
+        for j in range(int(q_lens[bb])):
+            pos = int(kv_lens[bb]) - int(q_lens[bb]) + j
+            for hh in range(h):
+                hk = hh // g
+                s = (np.asarray(q[bb, j, hh], np.float32)
+                     @ k_ctx[:pos + 1, hk].T) / np.sqrt(d)
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                out[bb, j, hh] = p @ v_ctx[:pos + 1, hk]
+    return out
+
+
+def _shape_case(name, page_size):
+    """(q_lens, kv_lens, M) per raggedness shape, 4 lanes (one inactive
+    for all but the all-* shapes).  M is the SAME (2*page_size) for
+    every shape on purpose: raggedness lives in q_lens/kv_lens (the
+    padded query rows are masked), so the whole grid shares one
+    compiled kernel per (dtype, page size, mesh) — the grid stays
+    affordable inside the tier-1 budget."""
+    s = page_size
+    m = 2 * s
+    return {
+        # one query per live lane, lengths straddling page boundaries
+        "all_decode": ([1, 1, 1, 0], [2 * s + 1, s, 3, 0], m),
+        # fresh prompts: kv_lens == q_lens (no prior context)
+        "all_prefill": ([s + 3, 2 * s, 5, 3], [s + 3, 2 * s, 5, 3], m),
+        # decode + chunk + verify + idle in one batch
+        "mixed": ([1, s + 2, 5, 0], [2 * s, 2 * s + 2, s + 5, 0], m),
+        # K+1 verify (k=4) at varied context depths
+        "verify": ([5, 5, 5, 5], [7, s + 5, 2 * s + 5, 3 * s], m),
+        # segments crossing page boundaries exactly at/around the edge
+        "page_cross": ([4, 4, 1, 1], [s + 2, 2 * s, s + 1, s], m),
+    }[name]
+
+
+@pytest.mark.parametrize("mesh_n", [None, 2])
+@pytest.mark.parametrize("page_size", [4, 8])
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("shape", ["all_decode", "all_prefill", "mixed",
+                                   "verify", "page_cross"])
+def test_kernel_matches_reference_grid(shape, dtype, page_size, mesh_n):
+    """The parity drift guard of the satellite grid: every raggedness
+    shape x dtype x page size x mesh agrees with the dense reference."""
+    dt = jnp.dtype(dtype)
+    rng = jax.random.PRNGKey(hash((shape, page_size)) % 2**31)
+    hq, hkv, d = 4, 2, 16
+    q_lens, kv_lens, m = _shape_case(shape, page_size)
+    b = len(q_lens)
+    mp = 4   # fixed table width: every shape reuses one compiled kernel
+    pages = b * mp + 1
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (b, m, hq, d), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (pages, page_size, hkv, d),
+                               jnp.float32)
+    v_pool = jax.random.normal(ks[2], (pages, page_size, hkv, d),
+                               jnp.float32)
+    tables = jnp.asarray(
+        np.arange(1, b * mp + 1).reshape(b, mp), jnp.int32)
+    mesh = (make_mesh({"model": mesh_n}, jax.devices()[:mesh_n])
+            if mesh_n else None)
+    got = ragged_paged_attention(
+        q.astype(dt), jnp.stack([k_pool, v_pool], axis=1).astype(dt),
+        tables, jnp.asarray(q_lens, jnp.int32),
+        jnp.asarray(kv_lens, jnp.int32), mesh=mesh)
+    want = _reference(np.asarray(q), np.asarray(k_pool),
+                      np.asarray(v_pool), np.asarray(tables),
+                      q_lens, kv_lens)
+    tol = dict(rtol=2e-5, atol=2e-5) if dt == jnp.float32 \
+        else dict(rtol=5e-2, atol=5e-2)
+    for bb in range(b):
+        n = int(q_lens[bb])
+        np.testing.assert_allclose(
+            np.asarray(got, jnp.float32)[bb, :n], want[bb, :n], **tol)
+
+
+def test_kernel_long_walk_exceeds_pipeline_depth():
+    """More KV blocks than nbuf slots exercises the in-loop slot refill
+    (the DMA pipeline inherited from the single-query kernel)."""
+    rng = jax.random.PRNGKey(3)
+    hq, d, ps, mp = 2, 16, 4, 12
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (1, 3, hq, d), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (mp + 1, ps, hq, d), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (mp + 1, ps, hq, d), jnp.float32)
+    tables = jnp.asarray(np.arange(1, mp + 1)[None], jnp.int32)
+    q_lens = jnp.asarray([3], jnp.int32)
+    kv_lens = jnp.asarray([ps * mp - 1], jnp.int32)
+    got = ragged_paged_attention(
+        q, jnp.stack([k_pool, v_pool], axis=1), tables, q_lens, kv_lens,
+        g_pages=1, nbuf=2)  # pin the multi-block pipeline regime
+    want = _reference(np.asarray(q), np.asarray(k_pool),
+                      np.asarray(v_pool), np.asarray(tables), [3],
+                      [ps * mp - 1])
+    np.testing.assert_allclose(np.asarray(got)[0], want[0],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_rejects_unsplittable_heads_under_mesh():
+    mesh = make_mesh({"model": 2}, jax.devices()[:2])
+    q = jnp.zeros((1, 1, 3, 16), jnp.float32)
+    kvp = jnp.zeros((2, 2, 4, 3, 16), jnp.float32)
+    with pytest.raises(ValueError, match="divide the mesh"):
+        ragged_paged_attention(q, kvp, jnp.zeros((1, 1), jnp.int32),
+                               jnp.ones((1,), jnp.int32),
+                               jnp.ones((1,), jnp.int32), mesh=mesh)
+
+
+# ------------------------------------------------------------ engine ----
+
+_CASES = ((5, 12), (9, 8))  # (prompt_len, steps): both cross a page
+
+
+@pytest.fixture(scope="module")
+def lm():
+    p = init_transformer_params(vocab=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64)
+    # trained-model emulation (test_speculative_block): the early-exit
+    # draft must actually agree with the target sometimes
+    for w in ("wo", "w2"):
+        p["layer1"][w] = p["layer1"][w] * 0.05
+    return p
+
+
+def _batcher(lm, mesh_n=None, **kw):
+    mesh = (make_mesh({"model": mesh_n}, jax.devices()[:mesh_n])
+            if mesh_n else None)
+    kw.setdefault("lanes", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("decode_block", 4)  # bound per-mode compile variety
+    return ContinuousBatcher(lm, n_heads=2, n_layers=2, page_size=8,
+                             compute_dtype=jnp.float32, mesh=mesh, **kw)
+
+
+def _run_cases(cb):
+    """Greedy / device-sampled / logprobs / host-sampled streams through
+    one batcher — the four sampling verticals of the parity matrix."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 64, (n,), np.int32) for n, _ in _CASES]
+    out = [list(cb.submit(p, s).result(timeout=300))
+           for p, (_, s) in zip(prompts, _CASES)]
+    out.append(list(cb.submit(
+        prompts[0], 8, sampling=SamplingParams(
+            temperature=0.8, seed=42, device=True)).result(timeout=300)))
+    toks, lps = cb.submit(prompts[1], 6, logprobs=True).result(timeout=300)
+    out.append(list(toks))
+    out.append(list(cb.submit(
+        prompts[1], 6, sampling=SamplingParams(
+            temperature=0.9, top_k=5, seed=7)).result(timeout=300)))
+    return out, list(lps)
+
+
+@pytest.fixture(scope="module")
+def legacy_ref(lm):
+    cb = _batcher(lm, use_kernel=False)
+    try:
+        return _run_cases(cb)
+    finally:
+        cb.shutdown()
+
+
+@pytest.mark.parametrize("mode", ["ragged_xla", "kernel", "kernel_mesh"])
+def test_engine_token_parity(lm, legacy_ref, mode):
+    """Ragged plan == legacy split dispatch, bit-exact tokens across
+    greedy/device-sampled/logprobs/host-sampled, kernel and XLA
+    attention, mesh on and off — the house parity style."""
+    kw = {"ragged_xla": dict(use_kernel=False, ragged=True),
+          "kernel": dict(use_kernel=True),
+          "kernel_mesh": dict(use_kernel=True, mesh_n=2)}[mode]
+    cb = _batcher(lm, **kw)
+    try:
+        out, lps = _run_cases(cb)
+        assert cb.ragged and cb.prefill_dispatches == 0
+        assert cb.dispatch_kinds["mixed"] >= 1
+    finally:
+        cb.shutdown()
+    assert out == legacy_ref[0]
+    np.testing.assert_allclose(lps, legacy_ref[1], rtol=1e-5, atol=1e-5)
+
+
+def _run_spec(cb):
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, 64, (5,), np.int32)
+    b = rng.integers(0, 64, (9,), np.int32)
+    out = [list(cb.submit(a, 10).result(timeout=300)),
+           list(cb.submit(b, 6, sampling=SamplingParams(
+               temperature=0.7, seed=11, device=True)).result(timeout=300))]
+    return out
+
+
+@pytest.fixture(scope="module")
+def spec_ref(lm):
+    draft = early_exit_draft(lm, 1)
+    ref_cb = _batcher(lm, use_kernel=False, draft_params=draft,
+                      draft_n_layers=1)
+    try:
+        want = _run_spec(ref_cb)
+        assert ref_cb.spec_dispatches > 0
+        return want
+    finally:
+        ref_cb.shutdown()
+
+
+@pytest.mark.parametrize("mesh_n", [None, 2])
+def test_speculative_verify_parity(lm, spec_ref, mesh_n):
+    """The K+1 verify forward through the ragged kernel (the PR 7
+    follow-up retired) == the XLA-gather spec path, mesh on and off;
+    speculative dispatches actually ran."""
+    draft = early_exit_draft(lm, 1)
+    want = spec_ref
+    cb = _batcher(lm, use_kernel=True, mesh_n=mesh_n, draft_params=draft,
+                  draft_n_layers=1)
+    try:
+        got = _run_spec(cb)
+        assert cb.spec_dispatches > 0
+        assert cb.dispatch_kinds["verify"] == cb.spec_dispatches
+        assert cb.ragged_dispatches > 0
+    finally:
+        cb.shutdown()
+    assert got == want
+
+
+def test_mixed_round_is_one_fused_dispatch(lm):
+    """The acceptance guard: N simultaneous prompt fills fold into ONE
+    ragged dispatch (legacy: one prefill program per lane), a mixed
+    prefill+decode round costs one dispatch = one host sync, and the
+    ragged plan never runs a separate prefill program."""
+    cb = _batcher(lm, use_kernel=False, ragged=True, lanes=3)
+    rng = np.random.default_rng(2)
+    prompts = [rng.integers(0, 64, (6,), np.int32) for _ in range(3)]
+    try:
+        cb.submit(prompts[0], 1).result(timeout=300)  # warm the bucket
+        d0 = cb.decode_dispatches
+        s0 = cb.decode_host_syncs
+        m0 = cb.dispatch_kinds["mixed"]
+        futs = [cb.submit(p, 1) for p in prompts]
+        outs = [list(f.result(timeout=300)) for f in futs]
+        assert all(len(o) == 1 for o in outs)
+        # every round is one dispatch and one blocking fetch; the three
+        # prompt fills fold into at most two rounds (admission may split
+        # the arrivals), never one program per lane
+        assert cb.decode_dispatches - d0 <= 2
+        assert cb.decode_host_syncs - s0 == cb.decode_dispatches - d0
+        assert cb.dispatch_kinds["mixed"] - m0 == cb.decode_dispatches - d0
+        assert cb.prefill_dispatches == 0
+
+        # mixed prefill+decode: a prompt arriving mid-decode rides the
+        # same fused round as the decoding lane
+        evt = threading.Event()
+        f0 = cb.submit(prompts[0], 16,
+                       on_token=lambda t, i: evt.set() if i == 2 else None)
+        assert evt.wait(60)
+        d1 = cb.decode_dispatches
+        f1 = cb.submit(prompts[1], 4)
+        r1 = f1.result(timeout=300)
+        r0 = f0.result(timeout=300)
+        assert cb.dispatch_kinds["mixed"] - m0 >= 3
+        assert cb.decode_host_syncs == cb.decode_dispatches
+        assert cb.prefill_dispatches == 0
+        assert len(r0) == 16 and len(r1) == 4
+    finally:
+        cb.shutdown()
+
+
+def test_chunked_prefill_prefix_cache_and_resume(lm):
+    """Multi-round chunked prefill (prefill_chunk bounds the per-round
+    segment), prefix-cache hits, and preempt/resume all compose with
+    the ragged plan — token streams stay bit-exact vs legacy."""
+    rng = np.random.default_rng(3)
+    long_p = rng.integers(0, 64, (34,), np.int32)
+    short_p = rng.integers(0, 64, (7,), np.int32)
+    cb = _batcher(lm, use_kernel=False, ragged=True, max_len=96,
+                  prefill_chunk=16, prefix_cache=True, n_pages=40)
+    try:
+        o1 = list(cb.submit(long_p, 8).result(timeout=300))
+        hits0 = cb.prefix_cache.hits
+        assert list(cb.submit(long_p, 8).result(timeout=300)) == o1
+        assert cb.prefix_cache.hits > hits0     # ragged rounds share pages
+        assert cb.prefill_dispatches == 0
+    finally:
+        cb.shutdown()
+    ref = _batcher(lm, use_kernel=False, max_len=96)
+    try:
+        assert list(ref.submit(long_p, 8).result(timeout=300)) == o1
+    finally:
+        ref.shutdown()
+    # preemption: a higher-priority arrival evicts the ragged lane; the
+    # resume re-prefills through mixed rounds and stays bit-exact
+    cb = _batcher(lm, use_kernel=False, ragged=True, lanes=1,
+                  decode_block=2)
+    try:
+        f1 = cb.submit(short_p, 20, priority=0)
+        evt = threading.Event()
+        t = threading.Timer(0.2, evt.set)
+        t.start()
+        evt.wait()
+        f2 = cb.submit(long_p[:9], 4, priority=5)
+        r2, r1 = f2.result(timeout=300), f1.result(timeout=300)
+        assert cb.preemptions >= 1
+    finally:
+        cb.shutdown()
+    ref = _batcher(lm, use_kernel=False, lanes=1)
+    try:
+        assert list(ref.submit(short_p, 20).result(timeout=300)) == list(r1)
+        assert list(ref.submit(long_p[:9], 4).result(timeout=300)) == list(r2)
+    finally:
+        ref.shutdown()
+
+
+def test_ragged_metrics_and_debug_state(lm):
+    """GenerationMetrics picks up the ragged_dispatches counter and the
+    per-kind dispatch label; debugz reports the plan."""
+    pytest.importorskip("prometheus_client")
+    from prometheus_client import CollectorRegistry
+
+    from tpulab.utils.metrics import GenerationMetrics
+
+    cb = _batcher(lm, use_kernel=False, ragged=True)
+    m = GenerationMetrics(registry=CollectorRegistry())
+    try:
+        cb.submit(np.arange(5, dtype=np.int32) + 1, 6).result(timeout=300)
+        m.poll(cb)
+        dbg = cb.debug_state()["dispatch"]
+        assert dbg["ragged"] and dbg["ragged_dispatches"] >= 1
+        assert dbg["kinds"]["mixed"] >= 1
+    finally:
+        cb.shutdown()
+    got = {s.name: s.value for fam in m.registry.collect()
+           for s in fam.samples}
+    assert got.get("tpulab_llm_ragged_dispatches_total", 0) >= 1
+    kinds = {s.labels.get("kind"): s.value
+             for fam in m.registry.collect() if fam.name.endswith("by_kind")
+             for s in fam.samples if s.name.endswith("_total")}
+    assert kinds.get("mixed", 0) >= 1
+
+
+def test_use_kernel_false_is_the_escape_hatch(lm):
+    """Explicit use_kernel=False keeps the legacy split dispatch: no
+    mixed rounds, prefill programs still dispatched."""
+    cb = _batcher(lm, use_kernel=False)
+    try:
+        assert not cb.ragged
+        cb.submit(np.arange(5, dtype=np.int32) + 1, 4).result(timeout=300)
+        assert cb.dispatch_kinds["mixed"] == 0
+        assert cb.prefill_dispatches == 1
+        assert cb.ragged_dispatches == 0
+    finally:
+        cb.shutdown()
+
+
+@pytest.mark.slow
+def test_bench_ragged_attention_row(lm):
+    from tpulab.engine.paged import benchmark_ragged_attention
+    row = benchmark_ragged_attention(lanes=2, steps=8, prompt_len=6,
+                                     kernel=True)
+    assert row["ragged"]["parity"] and row["ragged_kernel"]["parity"]
+    assert row["ragged"]["dispatch_kinds"]["mixed"] >= 1

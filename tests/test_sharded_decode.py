@@ -86,11 +86,18 @@ def test_pool_rejects_bad_mesh_geometry(lm):
         PagedKVPool(8, 8, 2, 3, 16, jnp.float32, mesh=_mesh(2))
 
 
-def test_batcher_rejects_kernel_and_foreign_pool(lm):
-    """The pallas kernels are single-device programs; a provided pool
-    must be built on the batcher's own mesh."""
+def test_batcher_accepts_kernel_under_mesh_rejects_foreign_pool(lm):
+    """The ragged pallas kernel shards over the KV-heads dim (PR 8's
+    named follow-up retired): use_kernel=True under a mesh constructs —
+    only the single-device flash prefill still rejects — and a provided
+    pool must be built on the batcher's own mesh."""
+    cb = _batcher(lm, mesh=_mesh(2), use_kernel=True)
+    try:
+        assert cb.use_kernel and cb.ragged and cb.mesh is not None
+    finally:
+        cb.shutdown()
     with pytest.raises(ValueError, match="single-device"):
-        _batcher(lm, mesh=_mesh(2), use_kernel=True)
+        _batcher(lm, mesh=_mesh(2), prefill_flash=True)
     other = PagedKVPool(17, 8, 2, 2, 16, jnp.float32, mesh=_mesh(2))
     with pytest.raises(ValueError, match="different mesh"):
         _batcher(lm, mesh=make_mesh({"model": 2}, jax.devices()[2:4]),

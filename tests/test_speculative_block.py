@@ -459,6 +459,7 @@ def test_spec_admission_cost_factor(lm):
         cb_plain.shutdown()
 
 
+@pytest.mark.slow
 def test_benchmark_speculative_decode_row(lm):
     """The bench ``speculative_decode`` row on the CPU capture path:
     greedy parity recorded, nonzero acceptance, both modes' tok/s and

@@ -1,0 +1,298 @@
+"""Pallas ragged paged-attention kernel family (decode / verify / prefill).
+
+One kernel serves every paged-attention shape the engine dispatches
+("Ragged Paged Attention", PAPERS.md): each lane carries a *segment* of
+``q_lens[b]`` query tokens ending at context position ``kv_lens[b] - 1``
+over its own block table of KV pages.  Per-lane segment lengths key the
+whole family:
+
+- plain decode: ``q_lens = 1`` per live lane (the old single-query
+  kernel's shape);
+- K+1 speculative verify: ``q_lens = k + 1`` (current token + K draft
+  proposals, verified in one pass);
+- mixed chunked-prefill + decode rounds: prefilling lanes carry their
+  chunk (``q_lens = chunk``), decoding lanes carry 1 — ONE fused program
+  over the ragged batch instead of separate prefill and decode kinds.
+
+The XLA fallback gathers every lane's pages into a dense
+``(B, MP*S, H, D)`` tensor; this kernel walks the block table per lane,
+DMA-ing fused K/V pages from HBM into VMEM scratch (one DMA per page)
+through the same ``nbuf``-deep slot-rotation prefetch pipeline as the
+legacy single-query kernel (:mod:`tpulab.ops.paged_attention`), and
+accumulates softmax online per query row — O(block) VMEM, no gather
+materialization, dead pages skipped by predication.
+
+Per-head compute rides the flash-attention dot shapes (2D matmuls only,
+the Mosaic-serialization-safe subset :mod:`flash_attention` already
+uses): for each query head the block's scores are
+``q_h (M, D) x k_h^T -> (M, G*S)`` and the weighted values
+``p (M, G*S) x v_h -> (M, D)``, with the running (max, normalizer,
+accumulator) carried per head through the block walk.  GQA stages pages
+in the compact ``Hkv`` form (the bandwidth win) and slices each query
+head's KV block statically in VMEM.
+
+Sharded serving: ``mesh=`` wraps the kernel in ``shard_map`` over the
+KV-heads dim — each model-axis shard walks the SAME replicated block
+tables but DMAs only its own heads' page payloads (matching
+``kv_pool_sharding``) and attends its own query heads, so the kernel
+composes with the tensor-parallel engine instead of being rejected at
+construction.  ``interpret=True`` (automatic off TPU) runs the same
+kernel on CPU for hermetic tests — tier-1 exercises the real kernel
+path, sharded and not.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpulab.ops.paged_attention import _block_geometry
+
+_NEG = -1e30
+
+
+def _ragged_attn_kernel(tables_ref, qlens_ref, kvlens_ref, q_ref,
+                        kvpool_ref, o_ref, kv_buf, sem, *, page_size: int,
+                        max_pages: int, n_heads: int, head_dim: int,
+                        n_kv_heads: int, m_q: int, sm_scale: float,
+                        precision, g_pages: int, nbuf: int):
+    lane = pl.program_id(0)
+    qn = qlens_ref[lane]                      # valid query rows this lane
+    kvn = kvlens_ref[lane]                    # context length incl. segment
+    # last visible position; inactive lanes (kvn == 0) clamp to walking
+    # page 0 (the reserved scratch page) so the unconditional first-block
+    # DMA is always waited — their output rows are garbage the caller
+    # never consumes (q_lens == 0 masks them out downstream)
+    length = jnp.maximum(kvn, 1) - 1
+    start = kvn - qn                          # first query's position
+    h, d = n_heads, head_dim
+    hkv = n_kv_heads
+    g = h // hkv                              # GQA group size (1 = MHA)
+    gs = g_pages * page_size                  # KV rows per block
+    n_blocks = (max_pages + g_pages - 1) // g_pages
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale    # (M, H*D)
+    # flash-style 2D dots only (the Mosaic-safe subset): scores contract
+    # over D with the K block transposed, values with the standard
+    # orientation — see tpulab.ops.flash_attention._attn_kernel
+    dot_qk = functools.partial(
+        jax.lax.dot_general, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision)
+    dot_pv = functools.partial(
+        jax.lax.dot_general, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
+
+    def page_live(p):
+        return p * page_size <= length
+
+    # one block = g_pages fused-page DMAs issued back-to-back into the
+    # slot's per-page strips (dest strip static, source page id dynamic)
+    def start_block(j, slot):
+        for gg in range(g_pages):
+            p_idx = j * g_pages + gg
+
+            @pl.when(jnp.logical_and(p_idx < max_pages, page_live(p_idx)))
+            def _start(gg=gg, p_idx=p_idx):
+                page = tables_ref[lane * max_pages + p_idx]
+                pltpu.make_async_copy(
+                    kvpool_ref.at[page],
+                    kv_buf.at[slot, :, pl.ds(gg * page_size, page_size)],
+                    sem.at[slot, gg]).start()
+
+    def wait_block(j, slot):
+        for gg in range(g_pages):
+            p_idx = j * g_pages + gg
+
+            @pl.when(jnp.logical_and(p_idx < max_pages, page_live(p_idx)))
+            def _wait(gg=gg, p_idx=p_idx):
+                page = tables_ref[lane * max_pages + p_idx]
+                pltpu.make_async_copy(
+                    kvpool_ref.at[page],
+                    kv_buf.at[slot, :, pl.ds(gg * page_size, page_size)],
+                    sem.at[slot, gg]).wait()
+
+    def block_live(j):
+        return page_live(j * g_pages)  # first page live <=> any page live
+
+    # same deep prefetch pipeline as the single-query kernel (N-stage
+    # slot rotation; every started DMA is waited exactly once)
+    start_block(0, 0)  # block 0's first page is always live (length >= 0)
+    for jj in range(1, nbuf - 1):
+        if jj < n_blocks:
+            @pl.when(block_live(jj))
+            def _prologue(jj=jj):
+                start_block(jj, jj)
+
+    # per-query-row positions/validity are loop-invariant
+    qrow = jax.lax.broadcasted_iota(jnp.int32, (m_q, gs), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (m_q, gs), 1)
+    qpos = start + qrow                       # (M, G*S) per-row position
+    row_valid = qrow < qn
+    vrow = jax.lax.broadcasted_iota(jnp.int32, (gs, 1), 0)
+
+    def body(j, carry):
+        def attend(carry):
+            slot = jax.lax.rem(j, nbuf)
+            wait_block(j, slot)
+
+            @pl.when(jnp.logical_and(j + nbuf - 1 < n_blocks,
+                                     block_live(j + nbuf - 1)))
+            def _prefetch():
+                start_block(j + nbuf - 1,
+                            jax.lax.rem(j + nbuf - 1, nbuf))
+
+            kblk = kv_buf[slot, 0].astype(jnp.float32)   # (G*S, Hkv*D)
+            vblk = kv_buf[slot, 1].astype(jnp.float32)
+            # rows of dead/unfetched pages hold stale VMEM (possibly
+            # NaN): scores are neutralized by the mask below, but V
+            # rides a 0-weighted sum (0 * NaN = NaN) — zero explicitly
+            vblk = jnp.where(j * gs + vrow <= length, vblk, 0.0)
+            kpos = j * gs + col
+            mask = jnp.logical_and(kpos <= qpos, row_valid)  # (M, G*S)
+            out = []
+            for hh in range(h):
+                m_c, l_c, acc_c = carry[hh]
+                hk = hh // g                  # compact-form KV head
+                k_h = kblk[:, hk * d:(hk + 1) * d]          # (G*S, D)
+                v_h = vblk[:, hk * d:(hk + 1) * d]
+                q_h = q[:, hh * d:(hh + 1) * d]             # (M, D)
+                s = dot_qk(q_h, k_h)                        # (M, G*S)
+                s = jnp.where(mask, s, _NEG)
+                m_new = jnp.maximum(m_c, s.max(axis=1, keepdims=True))
+                alpha = jnp.exp(m_c - m_new)                # (M, 1)
+                p = jnp.exp(s - m_new) * mask.astype(jnp.float32)
+                l_new = l_c * alpha + p.sum(axis=1, keepdims=True)
+                acc_new = acc_c * alpha + dot_pv(p, v_h)    # (M, D)
+                out.append((m_new, l_new, acc_new))
+            return tuple(out)
+
+        # blocks fully beyond the lane's length contribute nothing — skip
+        return jax.lax.cond(block_live(j), attend, lambda c: c, carry)
+
+    init = tuple((jnp.full((m_q, 1), _NEG, jnp.float32),
+                  jnp.zeros((m_q, 1), jnp.float32),
+                  jnp.zeros((m_q, d), jnp.float32)) for _ in range(h))
+    final = jax.lax.fori_loop(0, n_blocks, body, init)
+    for hh in range(h):
+        _m, l_c, acc_c = final[hh]
+        o_ref[0, :, hh * d:(hh + 1) * d] = (
+            acc_c / jnp.maximum(l_c, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "g_pages", "nbuf"))
+def _ragged_attn(q, kv_pool, tables, q_lens, kv_lens, interpret: bool,
+                 g_pages: int | None = None, nbuf: int | None = None):
+    b, m, h, d = q.shape
+    n_pages, page_size, hkv = (kv_pool.shape[0], kv_pool.shape[2],
+                               kv_pool.shape[3])
+    if h % hkv:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hkv}")
+    max_pages = tables.shape[1]
+    # stage pages as (2, S, Hkv*D) fused K/V blocks (contiguous reshape;
+    # one DMA per page), queries as (B, M, H*D)
+    q2 = q.reshape(b, m, h * d)
+    kvp = kv_pool.reshape(n_pages, 2, page_size, hkv * d)
+    auto_g, auto_nbuf = _block_geometry(page_size, max_pages, hkv * d,
+                                        jnp.dtype(kv_pool.dtype).itemsize)
+    g_pages = g_pages or auto_g
+    nbuf = nbuf or auto_nbuf
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,           # tables (flat), q_lens, kv_lens
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, m, h * d), lambda lane, *_: (lane, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),   # KV pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, m, h * d),
+                               lambda lane, *_: (lane, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nbuf, 2, g_pages * page_size, hkv * d),
+                       kv_pool.dtype),
+            pltpu.SemaphoreType.DMA((nbuf, g_pages)),  # one DMA per page
+        ],
+    )
+    # f32 pools pin HIGHEST on the score dot (the default rounds f32 MXU
+    # operands to bf16); bf16 pools keep the fast default
+    precision = (jax.lax.Precision.HIGHEST
+                 if jnp.dtype(kv_pool.dtype).itemsize >= 4
+                 else jax.lax.Precision.DEFAULT)
+    kernel = functools.partial(
+        _ragged_attn_kernel, page_size=page_size, max_pages=max_pages,
+        n_heads=h, head_dim=d, n_kv_heads=hkv, m_q=m,
+        sm_scale=1.0 / np.sqrt(d), precision=precision,
+        g_pages=g_pages, nbuf=nbuf)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, m, h * d), q.dtype),
+        interpret=interpret,
+    )(tables.reshape(-1), q_lens, kv_lens, q2, kvp)
+    return out.reshape(b, m, h, d)
+
+
+def ragged_paged_attention(q, kv_pool, tables, q_lens, kv_lens,
+                           mesh=None, model_axis: str = "model",
+                           interpret: bool | None = None,
+                           g_pages: int | None = None,
+                           nbuf: int | None = None):
+    """Ragged paged attention over per-lane ``(query_len, kv_len)``
+    segments (MHA or grouped-query).
+
+    q (B, M, Hq, D) — up to M query tokens per lane, left-packed: lane
+    b's valid queries are ``q[b, :q_lens[b]]``, query j sitting at
+    global position ``kv_lens[b] - q_lens[b] + j`` and attending every
+    context position <= its own (the gather-after-scatter contract: the
+    segment's K/V are already resident in the pool);
+    kv_pool (P, 2, S, Hkv, D) — one layer's page pool in the FUSED
+    layout (axis 1 = K/V adjacent in HBM, one DMA per page; Hkv < Hq
+    selects GQA);
+    tables (B, MP) int32 page ids (padded rows point at scratch page 0);
+    q_lens (B,) int32 — segment length per lane (0 = inactive: output
+    rows are garbage the caller must mask);
+    kv_lens (B,) int32 — context length per lane INCLUDING the segment
+    (NOTE: a count, not the last position — ``q_lens == 1,
+    kv_lens == position + 1`` is the single-query decode shape).
+
+    ``mesh=`` shards the walk over the KV-heads dim via ``shard_map``
+    (page payloads per :func:`tpulab.parallel.sharding.kv_pool_sharding`,
+    q/output on the heads dim, tables/lengths replicated) so the kernel
+    compiles inside the engine's tensor-parallel jits.
+    ``g_pages``/``nbuf`` override the auto block geometry.
+    Returns (B, M, Hq, D).
+    """
+    if interpret is None:
+        from tpulab.tpu.platform import is_tpu
+        interpret = not is_tpu()
+    tables = tables.astype(jnp.int32)
+    q_lens = q_lens.astype(jnp.int32)
+    kv_lens = kv_lens.astype(jnp.int32)
+    if mesh is None:
+        return _ragged_attn(q, kv_pool, tables, q_lens, kv_lens,
+                            interpret, g_pages=g_pages, nbuf=nbuf)
+    from jax.sharding import PartitionSpec as P
+
+    from tpulab.parallel.sharding import shard_map
+    n_model = dict(mesh.shape)[model_axis]
+    h, hkv = q.shape[2], kv_pool.shape[3]
+    if h % n_model or hkv % n_model:
+        raise ValueError(
+            f"query heads ({h}) and KV heads ({hkv}) must divide the "
+            f"mesh {model_axis!r} axis ({n_model}) — the ragged kernel "
+            "shards on the heads dim")
+    body = functools.partial(_ragged_attn, interpret=interpret,
+                             g_pages=g_pages, nbuf=nbuf)
+    return shard_map(
+        body, mesh,
+        in_specs=(P(None, None, model_axis, None),
+                  P(None, None, None, model_axis, None),
+                  P(None, None), P(None), P(None)),
+        out_specs=P(None, None, model_axis, None),
+        check_rep=False,   # pallas_call has no shard_map replication rule
+    )(q, kv_pool, tables, q_lens, kv_lens)

@@ -59,17 +59,21 @@ def process_count() -> int:
     return _jax().process_count()
 
 
-def enable_compilation_cache(path: str = "") -> None:
+def enable_compilation_cache(path: str = "",
+                             min_compile_secs: float = 0.5) -> None:
     """Persistent XLA compilation cache — the runtime side of the AOT-engine
     story: recompiles of the same program/topology become disk hits, so
     server restarts skip the cold-compile (the TRT 'deserialize plan' UX).
+    ``min_compile_secs`` sets the caching threshold (the test harness
+    lowers it: tier-1 builds hundreds of small near-identical engines).
     """
     jax = _jax()
     cache_dir = path or os.environ.get(
         "TPULAB_COMPILE_CACHE", os.path.expanduser("~/.cache/tpulab/xla"))
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
 
 
 def force_cpu(n_devices: int = 8) -> None:

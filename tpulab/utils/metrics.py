@@ -565,6 +565,18 @@ class GenerationMetrics:
             f"{ns}_llm_decode_host_syncs",
             "Blocking device->host result fetches in decode",
             registry=self.registry)
+        self.ragged_dispatches = Counter(
+            f"{ns}_llm_ragged_dispatches",
+            "Dispatches through the ragged paged-attention family "
+            "(mixed prefill+decode rounds, plus decode/verify dispatches "
+            "whose attention ran the pallas ragged kernel)",
+            registry=self.registry)
+        self.dispatches_by_kind = Counter(
+            f"{ns}_llm_dispatches_by_kind",
+            "Decode dispatches by ragged-plan dispatch kind "
+            "(decode = K-blocks/single ticks, verify = speculative "
+            "draft+verify blocks, mixed = ragged prefill+decode rounds)",
+            ["kind"], registry=self.registry)
         self.tokens_per_dispatch = Gauge(
             f"{ns}_llm_tokens_per_dispatch",
             "Generated tokens per decode dispatch (lifetime ratio; ~K x "
@@ -703,6 +715,11 @@ class GenerationMetrics:
         syncs = getattr(batcher, "decode_host_syncs", 0)
         self._advance(self.decode_dispatches, "dispatches", dispatches)
         self._advance(self.decode_host_syncs, "syncs", syncs)
+        self._advance(self.ragged_dispatches, "ragged",
+                      getattr(batcher, "ragged_dispatches", 0))
+        for kind, n in getattr(batcher, "dispatch_kinds", {}).items():
+            self._advance(self.dispatches_by_kind.labels(kind=kind),
+                          f"kind_{kind}", n)
         # speculative decode telemetry: tokens_generated counts EMITTED
         # (accepted) tokens only, so tokens_per_dispatch below is never
         # inflated by drafted-but-rejected proposals — those show up
