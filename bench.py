@@ -20,7 +20,12 @@ Provenance (round-4, advisor-medium fix): the top-level ``value`` /
 those keys can never mistake a historical record for this run.  When the
 live run degrades to CPU, the most RECENT on-device record (latest-good,
 not best-ever) is attached under the separate ``last_good`` key with its
-capture time, round, source and age spelled out.
+capture time, round, source, age and ``age_rounds``/top-level
+``last_good_age_rounds`` (rounds since the carried number was actually
+measured) spelled out.  The canary's verdict is itself a bench row
+(``details.device_smoke``) WITH TEETH: a dead TPU canary makes the
+process exit 1 — the round hard-fails — while deliberate CPU smokes
+(DEGRADED/CPU_FULL) stay exit 0.
 Env knobs:
   TPULAB_BENCH_DEGRADED=1      force the flagged CPU fallback
   TPULAB_BENCH_DEADLINE_S      global deadline (default 1500)
@@ -287,11 +292,26 @@ def _emit_line(timeout_phase: str | None = None) -> None:
                 "source": lg.get("source_file", "BENCH_LAST_GOOD"),
                 "details": lg.get("details", {}),
             }
+            # staleness in ROUNDS, not wall time: a carried-forward
+            # number that is N rounds old has survived N chances to be
+            # refreshed — the signal a reviewer needs to distrust it
+            # (r03's 96.7 inf/s aging silently is the failure mode)
+            cur = os.environ.get("TPULAB_BENCH_ROUND")
+            cur_round = int(cur) if cur and cur.isdigit() else None
+            lg_round = _source_round(lg) or None
+            age_rounds = (cur_round - lg_round
+                          if cur_round is not None and lg_round is not None
+                          else None)
+            line["last_good"]["age_rounds"] = age_rounds
+            line["last_good_age_rounds"] = age_rounds
             line["device"] += (
                 f" [headline is the LIVE degraded result; last on-device "
                 f"capture: {lg['value']} {line['unit']} "
                 f"(round {_source_round(lg) or '?'}, "
-                f"{_record_age_str(lg)}) under 'last_good']")
+                f"{_record_age_str(lg)}"
+                + (f", {age_rounds} round(s) stale" if age_rounds
+                   is not None else "")
+                + ") under 'last_good']")
         # live-CPU trend (VERDICT r4 weak #5): the degraded number is the
         # only consistently available signal — compare it round-over-round
         # so a host-side serving regression is flagged, not shrugged off
@@ -327,7 +347,9 @@ def _watchdog(deadline_s: float) -> None:
     # main won the race, give its print a moment before exiting.
     _emit_line(timeout_phase=phase)
     time.sleep(2.0)
-    os._exit(0)
+    with _state_lock:
+        rc = int(_state.get("exit_code", 0))
+    os._exit(rc)  # a dead-canary round hard-fails even via the watchdog
 
 
 def _device_canary_subprocess(deadline_s: float) -> bool:
@@ -349,6 +371,26 @@ def _device_canary_subprocess(deadline_s: float) -> bool:
         return "CANARY_OK" in proc.stdout
     except Exception:
         return False
+
+
+def _device_smoke_row(canary_ok: bool | None,
+                      explicit_cpu: bool) -> tuple[dict, int]:
+    """The canary's verdict as a first-class bench row plus the process
+    exit code (ROADMAP item 3: the bench must have TEETH).  A dead TPU
+    canary hard-fails the round — exit 1 — so a dead device reads as a
+    dead device in CI instead of a quietly carried-forward number.
+    Deliberate CPU modes (TPULAB_BENCH_DEGRADED / TPULAB_BENCH_CPU_FULL
+    smokes) never ran the canary and never hard-fail."""
+    if explicit_cpu:
+        return ({"ok": False, "ran": False, "hard_fail": False,
+                 "reason": "explicit CPU mode "
+                           "(TPULAB_BENCH_DEGRADED/CPU_FULL)"}, 0)
+    if canary_ok:
+        return ({"ok": True, "ran": True, "hard_fail": False}, 0)
+    return ({"ok": False, "ran": True, "hard_fail": True,
+             "reason": "device canary dead after retries; round ran on "
+                       "CPU fallback and the round HARD-FAILS (exit 1)"},
+            1)
 
 
 def _device_alive_with_retry() -> bool:
@@ -374,17 +416,25 @@ def main() -> None:
 
     degraded = os.environ.get("TPULAB_BENCH_DEGRADED") == "1"
     cpu_full = os.environ.get("TPULAB_BENCH_CPU_FULL") == "1"  # CI smoke knob
+    canary_ok: bool | None = None
     if degraded or cpu_full:
         force_cpu(1)  # before any backend use — config API, env is ignored
-    elif not _device_alive_with_retry():
-        # wedged device: the subprocess canary left this process's backend
-        # untouched, so the CPU fallback is a plain in-process switch; the
-        # emitted line will carry forward the round's last good on-device
-        # record (see _emit_line)
-        degraded = True
-        force_cpu(1)
+    else:
+        canary_ok = _device_alive_with_retry()
+        if not canary_ok:
+            # wedged device: the subprocess canary left this process's
+            # backend untouched, so the CPU fallback is a plain in-process
+            # switch; the emitted line will carry forward the round's last
+            # good on-device record (see _emit_line)
+            degraded = True
+            force_cpu(1)
+    # canary_ok None <=> an env knob forced CPU before the canary ran
+    smoke, exit_code = _device_smoke_row(canary_ok,
+                                         explicit_cpu=canary_ok is None)
     with _state_lock:
         _state["degraded"] = degraded
+        _state["exit_code"] = exit_code
+        _state["details"]["device_smoke"] = smoke
 
     import numpy as np
     from tpulab.engine import InferBench, InferenceManager
@@ -1250,7 +1300,11 @@ def main() -> None:
     threading.Thread(target=mgr.shutdown, daemon=True).start()
     threading.Thread(target=mgr_b1.shutdown, daemon=True).start()
     time.sleep(2.0)
-    os._exit(0)
+    # the device_smoke verdict decides the exit code: a dead TPU canary
+    # hard-fails the round even though the CPU fallback produced a line
+    with _state_lock:
+        rc = int(_state.get("exit_code", 0))
+    os._exit(rc)
 
 
 if __name__ == "__main__":

@@ -639,7 +639,8 @@ def test_poll_load_reads_status_gauges():
                               "resident_models": [], "host_models": [],
                               # no prefix cache on a dense engine
                               "prefix_hits": 0, "prefix_lookups": 0,
-                              "draining": False}  # serving normally
+                              "draining": False,  # serving normally
+                              "inflight_requests": 0}  # drain observable
         assert rs._load_hint == [0]
     finally:
         if rs is not None:

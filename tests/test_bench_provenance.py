@@ -217,3 +217,65 @@ def test_complete_save_supersedes_partial_and_guards_best(repo, monkeypatch):
     assert "latest_partial" not in store  # superseded by the complete
     assert store["best"]["value"] == 120.0
     assert bench._load_last_good()["value"] == 120.0
+
+
+def test_emit_attaches_age_in_rounds(repo, monkeypatch, capsys):
+    """Staleness in ROUNDS, not wall time: a carried-forward record from
+    round 3 emitted in round 5 is 2 rounds stale — spelled out both
+    inside last_good and as the top-level last_good_age_rounds."""
+    _write(str(repo / "docs" / "BENCH_EARLY_r03.json"),
+           {"value": 96.7, "device": "TPU v4",
+            "captured_at": "2026-07-01T00:00:00Z"})
+    monkeypatch.setenv("TPULAB_BENCH_ROUND", "5")
+    monkeypatch.delenv("TPULAB_BENCH_NO_CARRY", raising=False)
+    monkeypatch.delenv("TPULAB_BENCH_CPU_FULL", raising=False)
+    monkeypatch.setattr(bench, "_state", {
+        "done": True, "phase": "emit", "device": "cpu", "degraded": True,
+        "details": {"b1_inf_s": 5.5}})
+    bench._emit_line()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["last_good"]["age_rounds"] == 2
+    assert line["last_good_age_rounds"] == 2
+    assert "2 round(s) stale" in line["device"]
+
+
+def test_emit_age_rounds_none_without_round_context(repo, monkeypatch,
+                                                    capsys):
+    """No TPULAB_BENCH_ROUND (local runs) -> age_rounds is explicitly
+    null, never a fabricated number."""
+    _write(str(repo / "docs" / "BENCH_EARLY_r03.json"),
+           {"value": 96.7, "device": "TPU v4"})
+    monkeypatch.delenv("TPULAB_BENCH_ROUND", raising=False)
+    monkeypatch.delenv("TPULAB_BENCH_NO_CARRY", raising=False)
+    monkeypatch.delenv("TPULAB_BENCH_CPU_FULL", raising=False)
+    monkeypatch.setattr(bench, "_state", {
+        "done": True, "phase": "emit", "device": "cpu", "degraded": True,
+        "details": {"b1_inf_s": 5.5}})
+    bench._emit_line()
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["last_good_age_rounds"] is None
+    assert "round(s) stale" not in line["device"]
+
+
+def test_device_smoke_dead_canary_hard_fails_the_round():
+    """The bench's TEETH (ROADMAP item 3): a dead TPU canary is a
+    first-class failing row AND a nonzero exit code — CI sees a dead
+    device as a dead device, not a quietly carried-forward number."""
+    row, rc = bench._device_smoke_row(False, explicit_cpu=False)
+    assert rc == 1
+    assert row["ran"] is True and row["ok"] is False
+    assert row["hard_fail"] is True
+
+
+def test_device_smoke_alive_canary_passes():
+    row, rc = bench._device_smoke_row(True, explicit_cpu=False)
+    assert rc == 0
+    assert row == {"ok": True, "ran": True, "hard_fail": False}
+
+
+def test_device_smoke_explicit_cpu_mode_never_hard_fails():
+    """Deliberate CPU modes (TPULAB_BENCH_DEGRADED / CPU_FULL smokes)
+    never ran the canary: the row says so and the round exits 0."""
+    row, rc = bench._device_smoke_row(None, explicit_cpu=True)
+    assert rc == 0
+    assert row["ran"] is False and row["hard_fail"] is False

@@ -145,6 +145,13 @@ def build_file() -> dp.FileDescriptorProto:
         # the prefix-affinity ring; the autoscaler retires it only once
         # the drain completes.  false = serving normally.
         field("draining", 12, F.TYPE_BOOL),
+        # streams currently being served (accepted, not yet final or
+        # cancelled).  The process-boundary drain path polls this: a
+        # preStop drain is complete only when draining AND
+        # inflight_requests == 0 AND queued_requests == 0 — the
+        # SubprocessReplicaProvider's observable equivalent of
+        # InferenceManager.drain's return value.
+        field("inflight_requests", 13, F.TYPE_INT64),
     ])
 
     fd.message_type.add(name="HealthRequest")
@@ -334,6 +341,10 @@ def main() -> int:
         "dn = pb.StatusResponse.FromString(dn.SerializeToString());"
         "assert dn.draining is True;"
         "assert pb.StatusResponse().draining is False;"
+        "fl = pb.StatusResponse(inflight_requests=3);"
+        "fl = pb.StatusResponse.FromString(fl.SerializeToString());"
+        "assert fl.inflight_requests == 3;"
+        "assert pb.StatusResponse().inflight_requests == 0;"
         "dbq = pb.DebugRequest(model_name='llm', profile_ticks=4,"
         " profile_dir='/tmp/prof');"
         "dbq = pb.DebugRequest.FromString(dbq.SerializeToString());"

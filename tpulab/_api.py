@@ -42,7 +42,7 @@ class InferenceManager(_EngineManager):
               model_hbm_budget: Optional[int] = None,
               model_host_budget: Optional[int] = None,
               pinned_models=(), hbm=None,
-              flight=None) -> "InferenceManager":
+              flight=None, fleet=None) -> "InferenceManager":
         """Expose registered models over the TRTIS-style gRPC service
         (reference manager.serve() -> BasicInferService).  ``batching=True``
         enables server-side dynamic batching across concurrent callers;
@@ -114,7 +114,7 @@ class InferenceManager(_EngineManager):
             batch_window_s=batch_window_s, metrics=metrics, trace=trace,
             generation_engines=generation_engines, watchdog=watchdog,
             admission=admission, role=role, modelstore=modelstore,
-            hbm=hbm, flight=flight)
+            hbm=hbm, flight=flight, fleet=fleet)
         if wait:
             self._server.run()
         else:

@@ -90,6 +90,19 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
                               affinity for that request (same fallback,
                               distinct evidence): routing chaos can only
                               forgo cache warmth, never strand a request
+    fleet.spawn               spawn_with_retry (tpulab.fleet.autoscaler),
+                              once per provider spawn attempt — error
+                              fails the attempt, drop models a spawn the
+                              scheduler lost (never came up); both
+                              degrade to bounded retry-with-backoff, so
+                              spawn chaos can delay capacity, never
+                              wedge the autoscaler or the supervisor
+    fleet.probe               FleetSupervisor.probe (tpulab.fleet), once
+                              per member classification — error/drop
+                              forgo THAT member's probe this tick
+                              (evidence discarded, retried next tick):
+                              probe chaos can delay healing, never
+                              declare a healthy replica dead
     batch.run                 BatchScheduler run loop (tpulab.batch), once
                               per scheduler pass — error/drop kill the
                               batch RUNNER mid-job: in-flight items are

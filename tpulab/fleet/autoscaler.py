@@ -51,7 +51,36 @@ from typing import Callable, Dict, List, Optional
 
 log = logging.getLogger("tpulab.fleet")
 
-__all__ = ["ReplicaProvider", "InProcessReplicaProvider", "FleetAutoscaler"]
+__all__ = ["ReplicaProvider", "InProcessReplicaProvider", "FleetAutoscaler",
+           "spawn_with_retry"]
+
+
+def spawn_with_retry(spawn_once: Callable[[], str], attempts: int = 4,
+                     backoff_s: float = 0.05, cap_s: float = 2.0) -> str:
+    """Run one provider spawn attempt under the ``fleet.spawn`` chaos
+    trip, retrying with exponential backoff.  ``error`` fails the
+    attempt outright; ``drop`` models a spawn that never comes up (the
+    scheduler lost the request) — both degrade to retry-with-backoff,
+    like every transient real-infrastructure spawn failure.  The final
+    failure propagates: a fleet that cannot spawn at all must say so."""
+    from tpulab import chaos
+
+    delay = backoff_s
+    last: Optional[BaseException] = None
+    for attempt in range(max(1, int(attempts))):
+        try:
+            if chaos.trip("fleet.spawn") == "drop":
+                raise chaos.ChaosError("injected drop at fleet.spawn")
+            return spawn_once()
+        except Exception as e:  # noqa: BLE001 - every flavor retries
+            last = e
+            log.warning("fleet spawn attempt %d/%d failed (%s: %s); "
+                        "retrying in %.2fs", attempt + 1, attempts,
+                        type(e).__name__, e, delay)
+            time.sleep(delay)
+            delay = min(delay * 2, cap_s)
+    assert last is not None
+    raise last
 
 
 class ReplicaProvider:
@@ -66,12 +95,22 @@ class ReplicaProvider:
     def drain(self, address: str, timeout_s: float = 30.0) -> bool:
         """Flip the replica draining (readiness false, Status reports
         ``draining=true``) and wait for in-flight work to finish.
-        Returns True when fully drained within the budget."""
+        Returns True when fully drained within the budget — ``timeout_s``
+        is a HARD cap on how long the call may block (the conformance
+        contract both providers are tested against)."""
         raise NotImplementedError
 
     def retire(self, address: str) -> None:
         """Tear the (drained) replica down and release its resources."""
         raise NotImplementedError
+
+    def is_alive(self, address: str) -> Optional[bool]:
+        """Liveness evidence for the supervisor's drain-vs-death call:
+        True/False when the provider can observe the replica's life
+        directly (a subprocess it holds), None when it cannot (an
+        address it never spawned — externally managed); None makes the
+        supervisor fall back to RPC-probe-streak evidence alone."""
+        return None
 
 
 class InProcessReplicaProvider(ReplicaProvider):
@@ -92,12 +131,14 @@ class InProcessReplicaProvider(ReplicaProvider):
         self._replicas: Dict[str, tuple] = {}  # addr -> (manager, closer)
 
     def spawn(self) -> str:
-        made = self._factory()
-        mgr, closer = made if isinstance(made, tuple) else (made, None)
-        addr = f"127.0.0.1:{mgr.server.bound_port}"
-        with self._lock:
-            self._replicas[addr] = (mgr, closer)
-        return addr
+        def once() -> str:
+            made = self._factory()
+            mgr, closer = made if isinstance(made, tuple) else (made, None)
+            addr = f"127.0.0.1:{mgr.server.bound_port}"
+            with self._lock:
+                self._replicas[addr] = (mgr, closer)
+            return addr
+        return spawn_with_retry(once)
 
     def adopt(self, address: str, manager, closer=None) -> None:
         """Register an externally created replica (the fleet's seed
@@ -116,7 +157,19 @@ class InProcessReplicaProvider(ReplicaProvider):
         if entry is None:
             return True  # unknown = already gone
         mgr = entry[0]
-        return bool(mgr.drain(timeout=timeout_s, settle_s=self._settle_s))
+        # timeout_s is a HARD cap (provider conformance contract, shared
+        # with SubprocessReplicaProvider): InferenceManager.drain waits
+        # max(timeout, settle_s), so an uncapped settle window would let
+        # this call overstay the caller's budget
+        return bool(mgr.drain(timeout=timeout_s,
+                              settle_s=min(self._settle_s, timeout_s)))
+
+    def is_alive(self, address: str) -> Optional[bool]:
+        """An adopted/spawned in-process replica lives exactly as long
+        as it remains registered; unknown addresses are None (no
+        process to observe)."""
+        with self._lock:
+            return True if address in self._replicas else None
 
     def retire(self, address: str) -> None:
         with self._lock:

@@ -26,6 +26,8 @@ only reports the subsystems it runs):
      "modelstore": {"resident", "host", "leases": {...}},
      "chaos": {"armed", "rules", "fired", "seen"},
      "watchdog": {...},
+     "fleet": {"election": {...}, "supervisor": {...},
+               "autoscaler": {...}},
      "flight": {"retained", "dropped", "kept_by_reason",
                 "exemplar_ids", "assembly_ms_p99"}}
 
@@ -62,7 +64,7 @@ def _engine_section(engine) -> Dict[str, Any]:
 
 def debug_snapshot(resources=None, *, generation_engines=None,
                    admission=None, hbm=None, modelstore=None,
-                   flight=None, watchdog=None,
+                   flight=None, watchdog=None, fleet=None,
                    model_name: str = "") -> Dict[str, Any]:
     """Assemble the live snapshot (module docstring layout).
 
@@ -80,6 +82,7 @@ def debug_snapshot(resources=None, *, generation_engines=None,
         modelstore = modelstore or getattr(resources, "modelstore", None)
         flight = flight or getattr(resources, "flight", None)
         watchdog = watchdog or getattr(resources, "watchdog", None)
+        fleet = fleet or getattr(resources, "fleet", None)
     snap: Dict[str, Any] = {"wall_time": time.time()}
 
     engines = {}
@@ -166,6 +169,16 @@ def debug_snapshot(resources=None, *, generation_engines=None,
             snap["watchdog"] = {"healthy": bool(watchdog.healthy)}
         except Exception:
             pass
+
+    if fleet is not None:
+        # control-plane state (tpulab.fleet.control.FleetController —
+        # or anything with .snapshot()): election + supervision +
+        # autoscaling, the "who leads / what died / what's draining"
+        # answers an operator pulls during fleet churn
+        try:
+            snap["fleet"] = fleet.snapshot()
+        except Exception as e:
+            snap["fleet"] = {"error": f"{type(e).__name__}: {e}"}
 
     if flight is not None:
         aq = flight.assembly_quantiles()

@@ -65,9 +65,15 @@ class InferResources(Resources):
                  generation_engines: Optional[Dict[str, object]] = None,
                  watchdog=None, trace=None, admission=None,
                  role: str = "unified", modelstore=None, hbm=None,
-                 flight=None):
+                 flight=None, fleet=None):
         self.manager = manager
         self.metrics = metrics
+        #: optional fleet control plane handle (anything with
+        #: ``snapshot()``, normally tpulab.fleet.FleetController): a
+        #: router-colocated replica reports election + supervision +
+        #: autoscaling state in its Debug snapshot.  None = not a
+        #: control-plane node.
+        self.fleet = fleet
         #: optional tpulab.obs.FlightRecorder — one tail-sampled wide
         #: event per request, assembled here at completion from the
         #: serving-path hooks (docs/OBSERVABILITY.md "Flight recorder").
@@ -232,6 +238,10 @@ class StatusContext(Context):
         # rolling-restart / fleet scale-down drain (tpulab.fleet): tell
         # every polling router this replica must gain nothing new
         resp.draining = res.draining
+        # streams currently in service: the observable the
+        # process-boundary drain path (SubprocessReplicaProvider.drain)
+        # polls — drained means draining AND inflight==0 AND queued==0
+        resp.inflight_requests = res.inflight_requests
         if res.hbm is not None:
             # unified HBM economy (tpulab.hbm): ONE honest headroom
             # gauge next to the per-pool page count
@@ -608,7 +618,7 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                         generation_engines: Optional[Dict[str, object]] = None,
                         watchdog=None, trace=None, admission=None,
                         role: str = "unified", modelstore=None,
-                        hbm=None, flight=None) -> Server:
+                        hbm=None, flight=None, fleet=None) -> Server:
     """Wire the inference service onto a Server
     (reference BasicInferService ctor infer.cc:644-678).
 
@@ -634,7 +644,11 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     :class:`tpulab.obs.FlightRecorder`: every request assembles one
     tail-sampled wide event at completion, and the ``Debug`` RPC's
     snapshot points at the retained exemplars (docs/OBSERVABILITY.md
-    "Flight recorder")."""
+    "Flight recorder").  ``fleet`` is an optional control-plane handle
+    (:class:`tpulab.fleet.FleetController` or anything with
+    ``snapshot()``): the Debug snapshot then carries a ``fleet`` section
+    — election, supervision and autoscaling state (docs/OBSERVABILITY.md
+    "Debugz")."""
     if admission is not None and trace is not None \
             and getattr(admission, "trace", None) is None:
         # adopt the service's recorder: admission-decision spans land on
@@ -656,7 +670,7 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                                generation_engines=generation_engines,
                                watchdog=watchdog, admission=admission,
                                role=role, modelstore=modelstore, hbm=hbm,
-                               flight=flight)
+                               flight=flight, fleet=fleet)
     server = Server(address, executor or Executor(n_threads=4))
     server._infer_resources = resources  # for shutdown
     service = AsyncService(SERVICE_NAME, resources)

@@ -562,7 +562,13 @@ class _BaseReplicaSet:
                              "host_models": host,
                              "prefix_hits": p_hits,
                              "prefix_lookups": p_lookups,
-                             "draining": drn}
+                             "draining": drn,
+                             # streams currently in service on the
+                             # replica (process-boundary drain/probe
+                             # evidence, tpulab.fleet.process)
+                             "inflight_requests": int(
+                                 getattr(resp, "inflight_requests", 0)
+                                 or 0)}
                 m = self._metrics
                 if m is not None and hasattr(m, "prefix_hits"):
                     # cold path (one Status RPC per replica per poll):

@@ -352,12 +352,16 @@ class ReplicaSetMetrics:
 
 
 class FleetMetrics:
-    """Observability for the fleet autoscaler
-    (:mod:`tpulab.fleet.autoscaler`): membership actions and the
-    queue-wait signal it scales on — the elasticity telemetry the
-    adaptive-orchestration line in PAPERS.md argues a scale controller
-    needs in order to be tunable (is it flapping? is the wait threshold
-    doing work?)."""
+    """Observability for the fleet control plane
+    (:mod:`tpulab.fleet`): autoscaler membership actions and the
+    queue-wait signal it scales on, plus the self-healing/election
+    telemetry (:mod:`tpulab.fleet.supervisor` /
+    :mod:`tpulab.fleet.election`) — replica deaths and respawns, the
+    crash-loop breaker alert, and which node currently leads.  The
+    elasticity telemetry the adaptive-orchestration line in PAPERS.md
+    argues a scale controller needs in order to be tunable (is it
+    flapping? is the wait threshold doing work? is a slot burning spawn
+    budget?)."""
 
     def __init__(self, namespace: str = "tpulab",
                  registry: Optional["CollectorRegistry"] = None):
@@ -385,8 +389,32 @@ class FleetMetrics:
             "The admission queue-wait EWMA the controller last evaluated "
             "(AdmissionController.queue_wait_ewma_s)",
             registry=self.registry)
+        self.replica_deaths = Counter(
+            f"{ns}_fleet_replica_deaths_total",
+            "Replicas the supervisor declared dead (process exited or "
+            "unreachable past the probe streak) — drains never count",
+            registry=self.registry)
+        self.respawns = Counter(
+            f"{ns}_fleet_respawns_total",
+            "Crashed replicas respawned by the supervisor (after "
+            "exponential backoff)", registry=self.registry)
+        self.crash_loops = Counter(
+            f"{ns}_fleet_crash_loops_total",
+            "Crash-loop breaker openings: a lineage died N times in the "
+            "window and is quarantined (spawn budget stops burning; "
+            "THIS is the alert to page on)", registry=self.registry)
+        self.leader_transitions = Counter(
+            f"{ns}_fleet_leader_transitions_total",
+            "Times THIS node gained control-plane leadership (lease "
+            "acquisitions; fleet-wide churn = sum over nodes)",
+            registry=self.registry)
+        self.is_leader = Gauge(
+            f"{ns}_fleet_is_leader",
+            "1 while this node holds the control-plane lease (runs the "
+            "supervisor + autoscaler), else 0", registry=self.registry)
+        self._was_leader = False
 
-    # -- hooks (called by the autoscaler; cold paths) -------------------
+    # -- hooks (called by the control plane; cold paths) ----------------
     def note_scale(self, up: bool) -> None:
         (self.scale_ups if up else self.scale_downs).inc()
 
@@ -398,6 +426,23 @@ class FleetMetrics:
 
     def set_queue_wait(self, seconds: float) -> None:
         self.queue_wait.set(max(0.0, float(seconds)))
+
+    def note_death(self) -> None:
+        self.replica_deaths.inc()
+
+    def note_respawn(self) -> None:
+        self.respawns.inc()
+
+    def note_crash_loop(self) -> None:
+        self.crash_loops.inc()
+
+    def set_leader(self, leading: bool) -> None:
+        """Gauge + edge-triggered transition counter (gains only)."""
+        leading = bool(leading)
+        self.is_leader.set(1 if leading else 0)
+        if leading and not self._was_leader:
+            self.leader_transitions.inc()
+        self._was_leader = leading
 
 
 class BatchMetrics:
