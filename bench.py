@@ -996,6 +996,23 @@ def main() -> None:
     except Exception as e:
         print(f"# prefix affinity row skipped: {e!r}", file=sys.stderr)
 
+    # fleet observability plane (docs/OBSERVABILITY.md "Fleet
+    # observability"): the SAME online trace over a 3-replica loopback
+    # fleet with the plane armed (FleetObserver fleetz scrapes + event
+    # journal) vs off.  The claims tracked: online p99 TTFT/ITL flat
+    # within noise armed-vs-off (federation rides the Status/Debug RPCs
+    # off the request path), per-scrape wall-clock cost, and the
+    # journal's append p99 (one locked write+flush per control-plane
+    # decision).
+    _phase("fleet_obs")
+    try:
+        from tpulab.fleet import benchmark_fleet_obs
+        _record(fleet_obs=benchmark_fleet_obs(
+            n_requests=16 if degraded else 24,
+            steps=4 if degraded else 6))
+    except Exception as e:
+        print(f"# fleet obs row skipped: {e!r}", file=sys.stderr)
+
     # offline batch lane (docs/SERVING.md "Offline batch lane"): a
     # diurnal online trace — bursts separated by idle valleys — with the
     # preemptible batch lane ON vs OFF.  The claims tracked: total

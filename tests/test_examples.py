@@ -326,6 +326,7 @@ def test_image_client_preprocessing(tmp_path):
     assert f32.dtype == np.float32 and abs(float(f32.mean())) < 3.0
 
 
+@pytest.mark.slow  # heavyweight e2e; tier-1 runtime headroom (see ROADMAP)
 def test_notebook_llm_serving():
     """The LLM-serving tour runs end to end (continuous batching, prefix
     cache, streaming, speculative decoding)."""
@@ -353,6 +354,7 @@ def _spawn_llm_server(env, *extra_args, oneshot=True):
         env=env)
 
 
+@pytest.mark.slow  # heavyweight e2e; tier-1 runtime headroom (see ROADMAP)
 def test_07_llm_server_metrics_export():
     """--metrics-port: tpulab_llm_* series reflect real serving (tokens
     generated, prefix-cache state) after a generation completes."""

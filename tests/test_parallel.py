@@ -438,6 +438,7 @@ def test_checkpoint_cross_mesh_restore(tmp_path):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(x))
 
 
+@pytest.mark.slow  # heavyweight e2e; tier-1 runtime headroom (see ROADMAP)
 def test_checkpoint_resume_across_process_restart(tmp_path):
     """Crash/resume across real process boundaries: part1 trains+saves and
     exits; a fresh process resumes and must reproduce the uninterrupted

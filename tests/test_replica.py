@@ -506,11 +506,14 @@ def test_breaker_chaos_metrics_failover_open_probe_restore():
         assert sample("tpulab_replica_attempts_total",
                       {"code": "ChaosError"}) == 1
         assert sample("tpulab_replica_attempts_total", {"code": "OK"}) == 1
-        ejected = [a for a, s in rs.breaker_states().items()
-                   if s != "closed"]
+        # identify the ejected replica by its monotonic open-transition
+        # counter, not the live breaker state: with a 0.05s probe backoff
+        # the background probe can restore the breaker before this line
+        # runs on a slow machine
+        ejected = [a for a in addrs
+                   if sample("tpulab_replica_breaker_transitions_total",
+                             {"replica": a, "to": "open"}) == 1]
         assert len(ejected) == 1
-        assert sample("tpulab_replica_breaker_transitions_total",
-                      {"replica": ejected[0], "to": "open"}) == 1
         # the background probe (healthy replica, short backoff) restores it
         deadline = time.time() + 30
         while time.time() < deadline:

@@ -545,6 +545,7 @@ def test_spec_probe_recovers_after_transient_degrade(lm, dense):
     assert cb.pool.free_pages == cb.pool.n_pages - 1
 
 
+@pytest.mark.slow  # heavyweight e2e; tier-1 runtime headroom (see ROADMAP)
 def test_spec_probe_stays_degraded_on_adversarial_draft(lm, dense):
     """Probes on a lane whose draft is truly bad keep failing closed: the
     argmin draft degrades the lane via the EWMA, periodic probes fire

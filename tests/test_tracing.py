@@ -1,6 +1,7 @@
 """Tracing/StageTimer tests."""
 
 import numpy as np
+import pytest
 
 from tpulab.utils.tracing import StageTimer, annotate
 
@@ -24,6 +25,7 @@ def test_annotate_runs():
         (jnp.ones((8, 8)) * 2).block_until_ready()
 
 
+@pytest.mark.slow  # heavyweight e2e; tier-1 runtime headroom (see ROADMAP)
 def test_profiler_trace_capture(tmp_path):
     import os
     import jax.numpy as jnp
@@ -384,7 +386,8 @@ def test_metrics_inventory_documented_and_disjoint():
     collectors = (M.InferenceMetrics, M.ReplicaSetMetrics,
                   M.GenerationMetrics, M.AdmissionMetrics,
                   M.KVTierMetrics, M.ModelStoreMetrics, M.HBMMetrics,
-                  M.ChaosMetrics, M.FleetMetrics, M.BatchMetrics)
+                  M.ChaosMetrics, M.FleetMetrics, M.BatchMetrics,
+                  M.SLOMetrics, M.FederationMetrics)
     families = {}
     for cls in collectors:
         m = cls(registry=CollectorRegistry())
@@ -409,3 +412,28 @@ def test_metrics_inventory_documented_and_disjoint():
             assert not shared, (
                 f"{a} and {b} both export {sorted(shared)} — collector "
                 "name-prefixes must stay pairwise disjoint")
+
+
+def test_chaos_trip_points_documented():
+    """Companion drift guard: every chaos trip point armed anywhere in
+    tpulab/ has a row in the docs/ROBUSTNESS.md injection-point table
+    (``| `point` |``) — a new trip point lands WITH its documented
+    blast radius, and a renamed one updates the docs."""
+    import os
+    import re
+
+    points = set()
+    for dirpath, _dirs, files in os.walk(f"{REPO}/tpulab"):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            src = open(os.path.join(dirpath, fn),
+                       encoding="utf-8").read()
+            points |= set(re.findall(r'chaos\.trip\(\s*"([a-z_.]+)"',
+                                     src))
+    assert len(points) >= 17, f"trip-point scan broke: {sorted(points)}"
+    doc = open(f"{REPO}/docs/ROBUSTNESS.md").read()
+    for point in sorted(points):
+        assert f"| `{point}`" in doc, (
+            f"chaos trip point {point!r} has no docs/ROBUSTNESS.md "
+            "injection-point table row")

@@ -21,6 +21,13 @@ scheduling layer ABOVE the replica sets.
   routers share one membership view and exactly ONE runs the
   supervisor/autoscaler; followers converge on the leader's published
   snapshot and take over within one lease TTL.
+- :mod:`tpulab.fleet.observer` — telemetry federation: the
+  :class:`FleetObserver` assembles ONE fleet snapshot (``fleetz``) over
+  the Status/Debug RPCs, refreshes the replica-labeled ``_fed_*``
+  gauges, and merges per-replica Chrome traces / flight dumps onto one
+  wall-clock timeline.  Control-plane decisions journal through
+  :class:`tpulab.obs.EventJournal` (pass ``journal=`` to the
+  supervisor/elector/autoscaler/controller).
 
 Consumed by :class:`tpulab.rpc.replica.GenerationReplicaSet`
 (``prefix_affinity=True`` routes through the HRW router; the set's
@@ -32,12 +39,14 @@ docs/SERVING.md "Fleet routing & autoscaling" + "Running a real fleet".
 from tpulab.fleet.autoscaler import (FleetAutoscaler,  # noqa: F401
                                      InProcessReplicaProvider,
                                      ReplicaProvider, spawn_with_retry)
-from tpulab.fleet.bench import benchmark_prefix_affinity  # noqa: F401
+from tpulab.fleet.bench import (benchmark_fleet_obs,  # noqa: F401
+                                benchmark_prefix_affinity)
 from tpulab.fleet.control import FleetController  # noqa: F401
 from tpulab.fleet.election import (FileLeaseBackend,  # noqa: F401
                                    LeaderElector, LeaseBackend,
                                    StaleLeaderError, apply_membership,
                                    membership_snapshot)
+from tpulab.fleet.observer import FleetObserver  # noqa: F401
 from tpulab.fleet.process import SubprocessReplicaProvider  # noqa: F401
 from tpulab.fleet.router import (PrefixAffinityRouter,  # noqa: F401
                                  prefix_digest)
@@ -47,6 +56,6 @@ __all__ = ["PrefixAffinityRouter", "prefix_digest", "FleetAutoscaler",
            "ReplicaProvider", "InProcessReplicaProvider",
            "SubprocessReplicaProvider", "FleetSupervisor",
            "LeaseBackend", "FileLeaseBackend", "LeaderElector",
-           "StaleLeaderError", "FleetController", "membership_snapshot",
-           "apply_membership", "spawn_with_retry",
-           "benchmark_prefix_affinity"]
+           "StaleLeaderError", "FleetController", "FleetObserver",
+           "membership_snapshot", "apply_membership", "spawn_with_retry",
+           "benchmark_prefix_affinity", "benchmark_fleet_obs"]

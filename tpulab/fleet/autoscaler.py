@@ -213,7 +213,11 @@ class FleetAutoscaler:
                  min_replicas: int = 1, max_replicas: int = 8,
                  hold: int = 2, cooldown_s: float = 0.0,
                  drain_timeout_s: float = 30.0, metrics=None,
-                 batch_drain: Optional[Callable[[str], None]] = None):
+                 batch_drain: Optional[Callable[[str], None]] = None,
+                 journal=None,
+                 slo_signal: Optional[Callable[[], float]] = None,
+                 slo_scale_up: bool = False,
+                 up_slo_burn: float = 10.0):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
         if max_replicas < min_replicas:
@@ -240,6 +244,19 @@ class FleetAutoscaler:
         #: pressure by construction: its wait signal is the admission
         #: queue-wait EWMA, which batch-class admissions never feed.
         self._batch_drain = batch_drain
+        #: control-plane event journal (tpulab.obs.journal): every
+        #: decision lands with its evidence — scale_up / drain_start
+        #: carry the wait-EWMA, overload delta and SLO burn the tick
+        #: evaluated; drain_timeout and scale_down close the story
+        self._journal = journal
+        #: per-tenant SLO burn as a SECONDARY scale-up trigger
+        #: (tpulab.obs.slo.SLOTracker.scale_signal — already excludes
+        #: the batch class), behind a default-OFF flag: burn-driven
+        #: scaling is an operator opt-in, never a surprise.  Both the
+        #: flag and the signal must be set for it to fire.
+        self._slo_signal = slo_signal
+        self.slo_scale_up = bool(slo_scale_up) and slo_signal is not None
+        self.up_slo_burn = float(up_slo_burn)
         self._lock = threading.Lock()
         self._up_streak = 0
         self._down_streak = 0
@@ -270,6 +287,24 @@ class FleetAutoscaler:
         self._last_overloads = now
         return max(0, delta)
 
+    def _slo_burn(self) -> float:
+        if not self.slo_scale_up:
+            return 0.0
+        try:
+            return float(self._slo_signal())
+        except Exception:  # a torn-down tracker must not kill the loop
+            log.exception("fleet slo_signal failed; treating as 0")
+            return 0.0
+
+    def _journal_event(self, kind: str, **fields) -> None:
+        j = self._journal
+        if j is None:
+            return
+        try:
+            j.record(kind, **fields)
+        except Exception:  # noqa: BLE001 - journal must not break scaling
+            log.exception("autoscaler journal write failed")
+
     # -- the control tick ---------------------------------------------------
     def evaluate(self) -> str:
         """One control tick.  Returns the action taken: ``""`` (none),
@@ -284,30 +319,38 @@ class FleetAutoscaler:
                 return "draining"
             wait = self._queue_wait_s()
             overloads = self._overload_delta()
+            slo_burn = self._slo_burn()  # 0.0 unless armed AND opted in
             self._note_signals(wait)
             active = self._rs.active_count
+            burning = self.slo_scale_up and slo_burn >= self.up_slo_burn
             pressured = (overloads >= self.up_overloads
                          or (self._wait_signal is not None
-                             and wait >= self.up_wait_s))
-            idle = wait <= self.down_wait_s and overloads == 0
+                             and wait >= self.up_wait_s)
+                         or burning)
+            idle = (wait <= self.down_wait_s and overloads == 0
+                    and not burning)
             self._up_streak = self._up_streak + 1 if pressured else 0
             self._down_streak = self._down_streak + 1 if idle else 0
             now = time.monotonic()
             cooling = now - self._last_action_t < self.cooldown_s
+            evidence = {"wait_ewma_s": round(wait, 6),
+                        "overload_delta": overloads}
+            if self.slo_scale_up:
+                evidence["slo_burn"] = round(slo_burn, 4)
             if (self._up_streak >= self.hold and not cooling
                     and active < self.max_replicas):
                 self._up_streak = 0
                 self._last_action_t = now
-                return self._scale_up_locked()
+                return self._scale_up_locked(evidence)
             if (self._down_streak >= self.hold and not cooling
                     and active > self.min_replicas):
                 self._down_streak = 0
                 self._last_action_t = now
-                return self._start_drain_locked()
+                return self._start_drain_locked(evidence)
         return ""
 
     # -- actions (CALLER HOLDS self._lock) ----------------------------------
-    def _scale_up_locked(self) -> str:
+    def _scale_up_locked(self, evidence: Optional[dict] = None) -> str:
         addr = self._provider.spawn()
         self._rs.add_replica(addr)
         self.scale_ups += 1
@@ -317,6 +360,9 @@ class FleetAutoscaler:
         if m is not None:
             m.note_scale(up=True)
             m.set_replicas(self._rs.active_count)
+        self._journal_event("scale_up", address=addr,
+                            active=self._rs.active_count,
+                            **(evidence or {}))
         return "scale_up"
 
     def _pick_victim_locked(self) -> Optional[str]:
@@ -331,7 +377,7 @@ class FleetAutoscaler:
         return min(reversed(active),
                    key=lambda a: (inflight.get(a, 0) + hints.get(a, 0)))
 
-    def _start_drain_locked(self) -> str:
+    def _start_drain_locked(self, evidence: Optional[dict] = None) -> str:
         victim = self._pick_victim_locked()
         if victim is None:
             return ""
@@ -354,6 +400,8 @@ class FleetAutoscaler:
         self._drain_addr = victim
         self._drain_done.clear()
         self._drain_ok = False
+        self._journal_event("drain_start", address=victim,
+                            **(evidence or {}))
         log.info("fleet scale-down: draining replica %s", victim)
 
         def run() -> None:
@@ -382,6 +430,8 @@ class FleetAutoscaler:
             log.warning("drain of %s did not complete in %.1fs; replica "
                         "stays draining, retirement deferred",
                         victim, self.drain_timeout_s)
+            self._journal_event("drain_timeout", address=victim,
+                                timeout_s=self.drain_timeout_s)
             self._drain_addr = victim
             self._drain_done.clear()
 
@@ -407,6 +457,9 @@ class FleetAutoscaler:
         if m is not None:
             m.note_scale(up=False)
             m.set_replicas(self._rs.active_count)
+        self._journal_event("scale_down", address=victim,
+                            drain_ok=True,
+                            active=self._rs.active_count)
         return True
 
     # -- telemetry ----------------------------------------------------------
@@ -436,4 +489,6 @@ class FleetAutoscaler:
                     "scale_downs": self.scale_downs,
                     "drains": self.drains,
                     "draining": self._drain_addr,
-                    "active": self._rs.active_count}
+                    "active": self._rs.active_count,
+                    "slo_scale_up": self.slo_scale_up,
+                    "up_slo_burn": self.up_slo_burn}

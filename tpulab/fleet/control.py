@@ -41,12 +41,25 @@ class FleetController:
     but only a node that has them can usefully lead."""
 
     def __init__(self, replica_set, elector: LeaderElector,
-                 supervisor=None, autoscaler=None, metrics=None):
+                 supervisor=None, autoscaler=None, metrics=None,
+                 journal=None):
         self._rs = replica_set
         self.elector = elector
         self.supervisor = supervisor
         self.autoscaler = autoscaler
         self._metrics = metrics
+        #: control-plane event journal (tpulab.obs.journal): the
+        #: controller journals its OWN transitions — membership_publish
+        #: (token + store seq + the view) and elect_fenced (a publish
+        #: rejected by the fencing check mid-tick).  Pass the same
+        #: journal to the elector/supervisor/autoscaler for the full
+        #: takeover story in one file.
+        self._journal = journal
+        #: the last membership document this node published (leader) or
+        #: applied (follower) — the one view leader and followers must
+        #: agree on, surfaced in :meth:`snapshot` for the debugz fleet
+        #: section
+        self.last_membership: Optional[Dict[str, Any]] = None
         self._applied_seq = 0
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
@@ -77,17 +90,35 @@ class FleetController:
         token = self.elector.fencing_token
         if token is not None:
             try:
-                self.elector.backend.publish_membership(
+                doc = self.elector.backend.publish_membership(
                     membership_snapshot(self._rs), token)
                 out["published"] = True
+                if doc is not None:
+                    self.last_membership = doc
+                    self._journal_event(
+                        "membership_publish", token=int(doc["token"]),
+                        store_seq=int(doc["seq"]),
+                        members=doc.get("members", []),
+                        draining=doc.get("draining", []),
+                        retired=doc.get("retired", []))
             except StaleLeaderError:
                 # fenced off mid-tick: a new leader exists; stand down
                 log.warning("membership publish fenced (token %s); "
                             "resigning", token)
+                self._journal_event("elect_fenced", token=int(token))
                 self.elector.resign()
                 out["leader"] = False
                 out["fenced"] = True
         return out
+
+    def _journal_event(self, kind: str, **fields) -> None:
+        j = self._journal
+        if j is None:
+            return
+        try:
+            j.record(kind, node_id=self.elector.node_id, **fields)
+        except Exception:  # noqa: BLE001 - journal must not break control
+            log.exception("controller journal write failed")
 
     def _follower_tick_locked(self) -> Dict[str, Any]:
         self.follower_ticks += 1
@@ -97,6 +128,7 @@ class FleetController:
             out["applied"] = apply_membership(self._rs, snap)
             self._applied_seq = int(snap["seq"])
             self.snapshots_applied += 1
+            self.last_membership = snap
         return out
 
     # -- background loop ----------------------------------------------------
@@ -134,6 +166,10 @@ class FleetController:
             "leader_ticks": self.leader_ticks,
             "follower_ticks": self.follower_ticks,
             "snapshots_applied": self.snapshots_applied,
+            # the published view this node last wrote (leader) or
+            # converged on (follower): token + store seq + membership —
+            # what leader and follower debugz must AGREE on
+            "membership": self.last_membership,
         }
         if self.supervisor is not None:
             out["supervisor"] = self.supervisor.snapshot()

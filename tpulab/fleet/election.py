@@ -75,10 +75,11 @@ class LeaseBackend:
         raise NotImplementedError
 
     def publish_membership(self, snapshot: Dict[str, Any],
-                           token: int) -> None:
+                           token: int) -> Optional[Dict[str, Any]]:
         """Leader-authored membership write, fenced: raises
         :class:`StaleLeaderError` unless ``token`` is the lease's
-        current token."""
+        current token.  Returns the published document (stamped with
+        ``token`` and the store's monotonic ``seq``)."""
         raise NotImplementedError
 
     def read_membership(self) -> Optional[Dict[str, Any]]:
@@ -190,7 +191,7 @@ class FileLeaseBackend(LeaseBackend):
                     int(lease["token"]))
 
     def publish_membership(self, snapshot: Dict[str, Any],
-                           token: int) -> None:
+                           token: int) -> Dict[str, Any]:
         with self._locked():
             lease, _ = self._lease_locked()
             current = int(lease["token"]) if lease else 0
@@ -202,6 +203,7 @@ class FileLeaseBackend(LeaseBackend):
             doc["token"] = int(token)
             doc["seq"] = (int(prev["seq"]) + 1) if prev else 1
             self._write(self._memberpath, doc)
+            return doc
 
     def read_membership(self) -> Optional[Dict[str, Any]]:
         with self._locked():
@@ -216,16 +218,37 @@ class LeaderElector:
     (``set_leader`` gauge + transition counter)."""
 
     def __init__(self, backend: LeaseBackend, node_id: Optional[str] = None,
-                 ttl_s: float = 2.0, metrics=None):
+                 ttl_s: float = 2.0, metrics=None, journal=None,
+                 journal_renew_every: int = 0):
         self.backend = backend
         self.node_id = node_id or f"{os.uname().nodename}:{os.getpid()}"
         self.ttl_s = float(ttl_s)
         self._metrics = metrics
+        #: control-plane event journal (tpulab.obs.journal.EventJournal
+        #: surface: ``record(kind, **fields)``) — injected as a plain
+        #: object so this module stays stdlib-only.  Transitions journal
+        #: as elect_acquire / elect_lost / elect_resign, each stamped
+        #: with the fencing token; steady-state successful renewals are
+        #: heartbeats, not transitions, and journal only every
+        #: ``journal_renew_every``-th time (0 = never — the default;
+        #: the lease file itself holds the live expiry).
+        self._journal = journal
+        self.journal_renew_every = int(journal_renew_every)
         self._token: Optional[int] = None
         self._lock = threading.Lock()
         #: observability counters
         self.acquisitions = 0
         self.losses = 0
+        self.renews = 0
+
+    def _journal_event(self, kind: str, **fields) -> None:
+        j = self._journal
+        if j is None:
+            return
+        try:
+            j.record(kind, node_id=self.node_id, **fields)
+        except Exception:  # noqa: BLE001 - journal must not break election
+            log.exception("election journal write failed")
 
     @property
     def is_leader(self) -> bool:
@@ -244,12 +267,20 @@ class LeaderElector:
             if self._token is not None:
                 if self.backend.renew(self.node_id, self._token,
                                       self.ttl_s):
+                    self.renews += 1
+                    if (self.journal_renew_every > 0
+                            and self.renews
+                            % self.journal_renew_every == 0):
+                        self._journal_event("elect_renew",
+                                            token=self._token,
+                                            renews=self.renews)
                     return True
                 # fenced or expired-and-taken: stand down immediately
                 log.warning("leader lease lost by %s (token %s)",
                             self.node_id, self._token)
-                self._token = None
+                lost_token, self._token = self._token, None
                 self.losses += 1
+                self._journal_event("elect_lost", token=lost_token)
                 self._note(False)
                 return False
             token = self.backend.try_acquire(self.node_id, self.ttl_s)
@@ -260,6 +291,7 @@ class LeaderElector:
             self.acquisitions += 1
             log.info("leadership acquired by %s (fencing token %d)",
                      self.node_id, token)
+            self._journal_event("elect_acquire", token=token)
             self._note(True)
             return True
 
@@ -272,8 +304,9 @@ class LeaderElector:
             try:
                 self.backend.release(self.node_id, self._token)
             finally:
-                self._token = None
+                released, self._token = self._token, None
                 self.losses += 1
+                self._journal_event("elect_resign", token=released)
                 self._note(False)
 
     def _note(self, leading: bool) -> None:
@@ -288,7 +321,8 @@ class LeaderElector:
                     "fencing_token": self._token,
                     "ttl_s": self.ttl_s,
                     "acquisitions": self.acquisitions,
-                    "losses": self.losses}
+                    "losses": self.losses,
+                    "renews": self.renews}
 
 
 # -- membership snapshots (leader publishes, followers apply) -----------------

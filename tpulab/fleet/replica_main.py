@@ -75,6 +75,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "may complete (k8s endpoint propagation)")
     ap.add_argument("--term-drain-s", type=float, default=2.0,
                     help="SIGTERM best-effort drain budget before exit")
+    # evidence-on-exit (docs/OBSERVABILITY.md "Fleet observability"):
+    # arm the per-replica Chrome trace / flight recorder and autosave
+    # them — periodically AND on SIGUSR1 drain / SIGTERM retire — so a
+    # retired (or killed) replica leaves artifacts the FleetObserver
+    # can collect and merge.  Env fallbacks (TPULAB_TRACE_PATH /
+    # TPULAB_FLIGHT_PATH) let a provider hand each spawn its own path
+    # without touching replica_args.
+    ap.add_argument("--trace-path", default=None,
+                    help="Chrome-trace dump path (env TPULAB_TRACE_PATH)")
+    ap.add_argument("--flight-path", default=None,
+                    help="flight-recorder JSONL dump path "
+                         "(env TPULAB_FLIGHT_PATH)")
+    ap.add_argument("--autosave-s", type=float, default=0.25,
+                    help="evidence autosave period (SIGKILL leaves the "
+                         "last periodic save; saves are atomic)")
     return ap
 
 
@@ -112,7 +127,11 @@ def _build_engine(args):
 
 
 def main(argv=None) -> int:
+    import os
+
     args = build_parser().parse_args(argv)
+    trace_path = args.trace_path or os.environ.get("TPULAB_TRACE_PATH")
+    flight_path = args.flight_path or os.environ.get("TPULAB_FLIGHT_PATH")
 
     if not args.native_platform:
         from tpulab.tpu.platform import force_cpu
@@ -120,13 +139,46 @@ def main(argv=None) -> int:
 
     import tpulab
 
+    trace_rec = flight_rec = None
+    if trace_path:
+        from tpulab.utils.tracing import ChromeTraceRecorder
+        trace_rec = ChromeTraceRecorder(
+            process_name=f"replica:{args.model_name}")
+    if flight_path:
+        from tpulab.obs import FlightRecorder
+        flight_rec = FlightRecorder()
+
+    def dump_evidence() -> None:
+        """Best-effort artifact save (atomic tmp+rename on both paths —
+        a save raced by SIGKILL leaves the previous complete file)."""
+        try:
+            if trace_rec is not None and len(trace_rec):
+                trace_rec.save(trace_path)
+        except Exception:  # noqa: BLE001 - evidence must not kill serving
+            pass
+        try:
+            if flight_rec is not None and len(flight_rec):
+                flight_rec.dump_jsonl(flight_path)
+        except Exception:  # noqa: BLE001
+            pass
+
     cb = _build_engine(args)
     mgr = tpulab.InferenceManager(max_exec_concurrency=1)
     mgr.serve(port=args.port, generation_engines={args.model_name: cb},
-              role=args.role)
+              role=args.role, trace=trace_rec, flight=flight_rec)
 
     stop = threading.Event()
     draining = threading.Event()
+
+    if trace_rec is not None or flight_rec is not None:
+        # periodic autosave (the helpers_lm_server discipline): a
+        # SIGKILLed replica still leaves its last complete save behind
+        def autosave() -> None:
+            while not stop.wait(max(0.05, args.autosave_s)):
+                dump_evidence()
+
+        threading.Thread(target=autosave, name="replica-evidence",
+                         daemon=True).start()
 
     def start_drain(*_sig) -> None:
         # preStop: idempotent, asynchronous — the signal handler must
@@ -134,10 +186,14 @@ def main(argv=None) -> int:
         if draining.is_set():
             return
         draining.set()
-        threading.Thread(
-            target=lambda: mgr.drain(timeout=args.drain_timeout_s,
-                                     settle_s=args.drain_settle_s),
-            name="replica-drain", daemon=True).start()
+
+        def run_drain() -> None:
+            mgr.drain(timeout=args.drain_timeout_s,
+                      settle_s=args.drain_settle_s)
+            dump_evidence()  # drained = quiesced: a consistent capture
+
+        threading.Thread(target=run_drain, name="replica-drain",
+                         daemon=True).start()
 
     def request_stop(*_sig) -> None:
         stop.set()
@@ -157,6 +213,7 @@ def main(argv=None) -> int:
         mgr.drain(timeout=args.term_drain_s, settle_s=0.0)
     except Exception:
         pass
+    dump_evidence()  # evidence-on-exit: the artifacts outlive the process
     for closer in (mgr.shutdown, cb.shutdown):
         try:
             closer()

@@ -82,9 +82,15 @@ class FleetSupervisor:
                  crash_loop_window_s: float = 60.0,
                  crash_loop_deaths: int = 3,
                  unreachable_probes: int = 3,
-                 metrics=None, clock=time.monotonic):
+                 metrics=None, clock=time.monotonic, journal=None):
         self._rs = replica_set
         self._provider = provider
+        #: control-plane event journal (tpulab.obs.journal.EventJournal
+        #: surface) — every classification lands as one structured
+        #: event: replica_death (with its evidence — exit code vs probe
+        #: streak — and the scheduled backoff), replica_respawn,
+        #: spawn_failure, replica_quarantine / replica_unquarantine
+        self._journal = journal
         self.probe_timeout_s = float(probe_timeout_s)
         self.respawn_backoff_s = float(respawn_backoff_s)
         self.respawn_backoff_cap_s = float(respawn_backoff_cap_s)
@@ -141,10 +147,20 @@ class FleetSupervisor:
                     # injected probe chaos never kills a healthy replica
                     self.probes_forgone += 1
                     continue
-                if self._is_dead_locked(addr, lin, health):
-                    self._note_death_locked(lin, now, actions)
+                evidence = self._death_evidence_locked(addr, lin, health)
+                if evidence is not None:
+                    self._note_death_locked(lin, now, actions, evidence)
             self._respawn_due_locked(now, actions)
         return actions
+
+    def _journal_event(self, kind: str, **fields) -> None:
+        j = self._journal
+        if j is None:
+            return
+        try:
+            j.record(kind, **fields)
+        except Exception:  # noqa: BLE001 - journal must not break healing
+            log.exception("supervisor journal write failed")
 
     # -- classification (CALLER HOLDS self._lock) ---------------------------
     def _adopt_locked(self, states: Dict[str, str]) -> None:
@@ -157,31 +173,44 @@ class FleetSupervisor:
                 continue
             self._lineages[addr] = _Lineage(addr)
 
-    def _is_dead_locked(self, addr: str, lin: _Lineage,
-                        health: Dict[str, dict]) -> bool:
+    def _death_evidence_locked(self, addr: str, lin: _Lineage,
+                               health: Dict[str, dict]) -> Optional[dict]:
+        """None = alive (or not yet provably dead); otherwise the
+        structured evidence behind the death call — what the journal
+        records and a postmortem reads first."""
         alive = None
         try:
             alive = self._provider.is_alive(addr)
         except Exception:  # pragma: no cover - evidence, not control
             pass
         if alive is False:
-            return True  # the process provably exited while not draining
+            # the process provably exited while not draining; the exit
+            # code (when the provider held the process) distinguishes a
+            # clean-but-unexpected exit from a crash or an injected kill
+            exit_code = None
+            try:
+                if hasattr(self._provider, "exit_code"):
+                    exit_code = self._provider.exit_code(addr)
+            except Exception:  # pragma: no cover - evidence best-effort
+                pass
+            return {"evidence": "exit", "exit_code": exit_code}
         h = health.get(addr)
         reachable = bool(h and h.get("live"))
         if reachable:
             lin.streak = 0
-            return False
+            return None
         lin.streak += 1
         if lin.streak < self.unreachable_probes:
-            return False
+            return None
         # live-but-unreachable past the streak threshold: force the
         # teardown so the slot's resources actually free before respawn
         log.warning("replica %s unreachable for %d probes; declaring "
                     "dead", addr, lin.streak)
-        return True
+        return {"evidence": "probe_streak", "streak": lin.streak}
 
     def _note_death_locked(self, lin: _Lineage, now: float,
-                           actions: Dict[str, List[str]]) -> None:
+                           actions: Dict[str, List[str]],
+                           evidence: Optional[dict] = None) -> None:
         addr = lin.address
         self._rs.retire_replica(addr)
         try:
@@ -205,6 +234,12 @@ class FleetSupervisor:
             actions["quarantined"].append(addr)
             if m is not None and hasattr(m, "note_crash_loop"):
                 m.note_crash_loop()
+            self._journal_event("replica_death", address=addr,
+                                recent_deaths=len(lin.deaths),
+                                **(evidence or {}))
+            self._journal_event("replica_quarantine", address=addr,
+                                recent_deaths=len(lin.deaths),
+                                window_s=self.crash_loop_window_s)
             log.error("replica lineage %s crash-looped (%d deaths in "
                       "%.0fs): quarantined — unquarantine() to resume",
                       addr, len(lin.deaths), self.crash_loop_window_s)
@@ -213,6 +248,10 @@ class FleetSupervisor:
             self.respawn_backoff_s * (2 ** (len(lin.deaths) - 1)),
             self.respawn_backoff_cap_s)
         lin.respawn_due = now + lin.backoff_s
+        self._journal_event("replica_death", address=addr,
+                            recent_deaths=len(lin.deaths),
+                            respawn_backoff_s=lin.backoff_s,
+                            **(evidence or {}))
         log.warning("replica %s died (%d recent deaths); respawn in "
                     "%.2fs", addr, len(lin.deaths), lin.backoff_s)
 
@@ -230,6 +269,9 @@ class FleetSupervisor:
                                         self.respawn_backoff_s),
                                     self.respawn_backoff_cap_s)
                 lin.respawn_due = now + lin.backoff_s
+                self._journal_event("spawn_failure", lineage=old_addr,
+                                    spawn_failures=lin.spawn_failures,
+                                    retry_in_s=lin.backoff_s)
                 log.exception("respawn for lineage %s failed; next "
                               "attempt in %.2fs", old_addr, lin.backoff_s)
                 continue
@@ -238,6 +280,9 @@ class FleetSupervisor:
             lin.respawns += 1
             self.respawns += 1
             actions["respawns"].append(new_addr)
+            self._journal_event("replica_respawn", lineage=old_addr,
+                                address=new_addr,
+                                respawns=lin.respawns)
             m = self._metrics
             if m is not None and hasattr(m, "note_respawn"):
                 m.note_respawn()
@@ -260,6 +305,7 @@ class FleetSupervisor:
             lin.deaths.clear()
             lin.backoff_s = 0.0
             lin.respawn_due = self._clock()
+            self._journal_event("replica_unquarantine", address=address)
             return True
 
     def snapshot(self) -> Dict[str, Any]:

@@ -89,6 +89,17 @@ class FlightRecorder:
         #: record-assembly cost samples (seconds) — the obs_overhead
         #: bench row's p99 source
         self._assembly_s = deque(maxlen=2048)
+        #: downstream consumers of the UNSAMPLED event stream
+        #: (tpulab.obs.slo rides here) — see add_tap
+        self._taps: List[Any] = []
+
+    def add_tap(self, fn) -> None:
+        """Subscribe ``fn(event)`` to every observed event BEFORE
+        retention sampling — aggregating consumers (the SLO tracker)
+        need the whole stream, not the tail-sampled survivors.  Taps
+        run on the request-completion path: keep them cheap; exceptions
+        are swallowed (a broken consumer must not fail requests)."""
+        self._taps.append(fn)
 
     # -- ingestion -----------------------------------------------------------
     def observe(self, event: Dict[str, Any]) -> Optional[int]:
@@ -101,6 +112,11 @@ class FlightRecorder:
         ``keep`` (the retention reason) and ``wall_time`` onto retained
         events and returns the record id (None = uniformly dropped)."""
         t0 = time.perf_counter()
+        for tap in tuple(self._taps):
+            try:
+                tap(event)
+            except Exception:  # noqa: BLE001 - consumers must not fail us
+                pass
         outcome = str(event.get("outcome", "SUCCESS") or "SUCCESS")
         e2e = event.get("e2e_s")
         with self._lock:

@@ -446,10 +446,44 @@ class _BaseReplicaSet:
                 self._max_failover = self._active_count_locked()
             mgr = self._managers[idx]
         log.info("replica %s retired from the set", address)
+        self._drop_metric_children(address)
         try:
             mgr.close()
         except Exception:  # pragma: no cover - teardown best-effort
             pass
+
+    def _drop_metric_children(self, address: str) -> None:
+        """Stop a tombstoned replica's label children from exporting
+        forever: a retired slot must disappear from /metrics, not
+        freeze at its last-known values (breaker one-hot, prefix
+        gauges, liveness, traffic counters).  A re-joined address gets
+        fresh children from ``add_replica``.  The cached child handles
+        (``_m_inflight``/``_m_requests``) stay valid for in-flight
+        callbacks — updates to a removed child simply no longer
+        export."""
+        m = self._metrics
+        if m is None:
+            return
+        from tpulab.utils.metrics import BREAKER_STATES
+        for name in ("requests", "inflight", "live", "prefix_hits",
+                     "prefix_lookups"):
+            child = getattr(m, name, None)
+            if child is None:
+                continue
+            try:
+                child.remove(address)
+            except (KeyError, AttributeError):
+                pass  # never labeled for this replica
+        for name, states in (("breaker_state", BREAKER_STATES),
+                             ("breaker_transitions", BREAKER_STATES)):
+            fam = getattr(m, name, None)
+            if fam is None:
+                continue
+            for s in states:
+                try:
+                    fam.remove(address, s)
+                except (KeyError, AttributeError):
+                    pass
 
     def _active_locked(self) -> List[int]:
         """Indices eligible for NEW work: not retired, not draining.

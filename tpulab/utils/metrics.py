@@ -1184,6 +1184,179 @@ class ChaosMetrics:
         chaos.set_observer(None)
 
 
+class SLOMetrics:
+    """Per-tenant SLO telemetry (`_slo_*`; tpulab.obs.slo,
+    docs/OBSERVABILITY.md "Fleet observability"): raw request/error/
+    latency-breach counters per (tenant, request class) plus the
+    multi-window burn-rate gauges — the "is tenant X meeting its SLO"
+    scrape surface, and the alerting input the classic fast+slow
+    multi-window burn alerts read."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.requests = Counter(
+            f"{ns}_slo_requests_total",
+            "SLO-accounted requests per tenant and request class "
+            "(client-cancelled requests are excluded — neither good "
+            "nor bad)", ["tenant", "request_class"],
+            registry=self.registry)
+        self.errors = Counter(
+            f"{ns}_slo_errors_total",
+            "Requests that failed the availability objective (terminal "
+            "outcome not SUCCESS), per tenant and request class",
+            ["tenant", "request_class"], registry=self.registry)
+        self.latency_breaches = Counter(
+            f"{ns}_slo_latency_breaches_total",
+            "Requests whose end-to-end latency exceeded the objective, "
+            "per tenant and request class",
+            ["tenant", "request_class"], registry=self.registry)
+        self.availability_burn = Gauge(
+            f"{ns}_slo_availability_burn_rate",
+            "Availability error-budget burn rate per tenant/class/"
+            "window (1.0 = budget exhausted exactly over the objective "
+            "period; >1 = burning early)",
+            ["tenant", "request_class", "window"],
+            registry=self.registry)
+        self.latency_burn = Gauge(
+            f"{ns}_slo_latency_burn_rate",
+            "Latency error-budget burn rate per tenant/class/window",
+            ["tenant", "request_class", "window"],
+            registry=self.registry)
+
+    # -- hooks (tpulab.obs.slo.SLOTracker) ------------------------------
+    def note_request(self, tenant: str, request_class: str,
+                     error: bool, breach: bool) -> None:
+        self.requests.labels(tenant=tenant,
+                             request_class=request_class).inc()
+        if error:
+            self.errors.labels(tenant=tenant,
+                               request_class=request_class).inc()
+        if breach:
+            self.latency_breaches.labels(
+                tenant=tenant, request_class=request_class).inc()
+
+    def set_burn(self, tenant: str, request_class: str, window: str,
+                 availability: float, latency: float) -> None:
+        self.availability_burn.labels(
+            tenant=tenant, request_class=request_class,
+            window=window).set(float(availability))
+        self.latency_burn.labels(
+            tenant=tenant, request_class=request_class,
+            window=window).set(float(latency))
+
+
+class FederationMetrics:
+    """Federated fleet view (`_fed_*`; tpulab.fleet.observer): the
+    FleetObserver refreshes these replica-labeled gauges from each
+    fleetz scrape's Status RPCs, so ONE /metrics endpoint on the
+    observer node shows every replica's load/headroom/drain state
+    side by side — the poor-operator's Prometheus federation.  Children
+    for replicas that leave the snapshot are pruned on the next scrape
+    (the stale-label-child discipline retire_replica follows)."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.scrapes = Counter(
+            f"{ns}_fed_scrapes_total",
+            "Federated fleet snapshots assembled by the observer",
+            registry=self.registry)
+        self.scrape_seconds = Gauge(
+            f"{ns}_fed_scrape_seconds",
+            "Wall-clock cost of the last federated snapshot (all "
+            "replica Status RPCs + assembly)", registry=self.registry)
+        self.replicas = Gauge(
+            f"{ns}_fed_replicas",
+            "Replicas in the last federated snapshot",
+            registry=self.registry)
+        self.up = Gauge(
+            f"{ns}_fed_replica_up",
+            "1 when the replica answered its Status RPC in the last "
+            "snapshot, else 0", ["replica"], registry=self.registry)
+        self.inflight = Gauge(
+            f"{ns}_fed_replica_inflight",
+            "Server-reported in-flight requests per replica",
+            ["replica"], registry=self.registry)
+        self.queued = Gauge(
+            f"{ns}_fed_replica_queued",
+            "Server-reported queued requests per replica",
+            ["replica"], registry=self.registry)
+        self.free_hbm_bytes = Gauge(
+            f"{ns}_fed_replica_free_hbm_bytes",
+            "Server-reported free HBM headroom per replica",
+            ["replica"], registry=self.registry)
+        self.free_kv_pages = Gauge(
+            f"{ns}_fed_replica_free_kv_pages",
+            "Server-reported free KV-cache pages per replica",
+            ["replica"], registry=self.registry)
+        self.draining = Gauge(
+            f"{ns}_fed_replica_draining",
+            "1 while the replica reports itself draining, else 0",
+            ["replica"], registry=self.registry)
+        self.prefix_hits = Gauge(
+            f"{ns}_fed_replica_prefix_hits",
+            "Server-reported lifetime prefix-cache hits per replica",
+            ["replica"], registry=self.registry)
+        self.prefix_lookups = Gauge(
+            f"{ns}_fed_replica_prefix_lookups",
+            "Server-reported lifetime prefix-cache lookups per replica",
+            ["replica"], registry=self.registry)
+        self.resident_models = Gauge(
+            f"{ns}_fed_replica_resident_models",
+            "Models resident in device memory per replica",
+            ["replica"], registry=self.registry)
+        self._seen: set = set()
+        self._per_replica = (self.up, self.inflight, self.queued,
+                             self.free_hbm_bytes, self.free_kv_pages,
+                             self.draining, self.prefix_hits,
+                             self.prefix_lookups, self.resident_models)
+
+    # -- hooks (tpulab.fleet.observer.FleetObserver) --------------------
+    def observe_scrape(self, seconds: float, replicas: int) -> None:
+        self.scrapes.inc()
+        self.scrape_seconds.set(float(seconds))
+        self.replicas.set(int(replicas))
+
+    def set_replica(self, replica: str, up: bool, inflight: int = 0,
+                    queued: int = 0, free_hbm_bytes: int = 0,
+                    free_kv_pages: int = 0, draining: bool = False,
+                    prefix_hits: int = 0, prefix_lookups: int = 0,
+                    resident_models: int = 0) -> None:
+        self._seen.add(replica)
+        self.up.labels(replica=replica).set(1 if up else 0)
+        self.inflight.labels(replica=replica).set(int(inflight))
+        self.queued.labels(replica=replica).set(int(queued))
+        self.free_hbm_bytes.labels(replica=replica).set(
+            int(free_hbm_bytes))
+        self.free_kv_pages.labels(replica=replica).set(
+            int(free_kv_pages))
+        self.draining.labels(replica=replica).set(1 if draining else 0)
+        self.prefix_hits.labels(replica=replica).set(int(prefix_hits))
+        self.prefix_lookups.labels(replica=replica).set(
+            int(prefix_lookups))
+        self.resident_models.labels(replica=replica).set(
+            int(resident_models))
+
+    def prune(self, keep) -> None:
+        """Drop label children for replicas no longer in the snapshot —
+        a retired replica must stop exporting, not freeze at its last
+        value."""
+        for replica in self._seen - set(keep):
+            for g in self._per_replica:
+                try:
+                    g.remove(replica)
+                except KeyError:  # pragma: no cover - never created
+                    pass
+        self._seen &= set(keep)
+
+
 class MultiRegistryCollector:
     """Aggregating collector: exposes several CollectorRegistry instances
     through one registry (hence one /metrics port).  Metric names must be
