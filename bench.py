@@ -1013,6 +1013,25 @@ def main() -> None:
     except Exception as e:
         print(f"# fleet obs row skipped: {e!r}", file=sys.stderr)
 
+    # fleet KV fabric (docs/SERVING.md "Fleet KV fabric"): the same
+    # 3-replica loopback fleet serving a zipfian trace with routing
+    # accuracy GONE (phase 2 round-robins every returning request),
+    # fabric ON vs OFF.  The claims tracked: fleet-effective hit rate
+    # strictly higher with the fabric ON and above PR 13's ~0.83
+    # affinity-working ceiling (astray requests pull the prefix from
+    # its home over FetchKV instead of recomputing), token parity
+    # between modes, zero stranded requests on degrades.  On CPU jit
+    # the hit/pull structure is the signal; on-device the TTFT gap is
+    # (a pull replaces a whole prefill on the request path).
+    _phase("kv_fabric")
+    try:
+        from tpulab.kvfabric import benchmark_kv_fabric
+        _record(kv_fabric=benchmark_kv_fabric(
+            n_requests=16 if degraded else 24,
+            steps=3 if degraded else 4))
+    except Exception as e:
+        print(f"# kv fabric row skipped: {e!r}", file=sys.stderr)
+
     # offline batch lane (docs/SERVING.md "Offline batch lane"): a
     # diurnal online trace — bursts separated by idle valleys — with the
     # preemptible batch lane ON vs OFF.  The claims tracked: total

@@ -134,6 +134,25 @@ def test_rendezvous_ranking_deterministic_and_minimal_movement():
     assert r.ring_moves == mv
 
 
+def test_ranked_is_the_one_shared_ordering():
+    """``ranked`` (the public HRW ordering `_pick_affine`, the hedge
+    pick, disagg home resolution and the KV fabric all share) equals
+    ``rank`` over canonicalized members, in any input order, and
+    defaults to the membership last recorded by ``note_membership`` —
+    so "the fabric's home" is always "the router's home"."""
+    from tpulab.fleet.router import PrefixAffinityRouter, prefix_digest
+    r = PrefixAffinityRouter(affinity_tokens=8)
+    members = [f"10.0.0.{i}:50051" for i in range(4)]
+    digs = [prefix_digest([i, 3, i * 11], 8) for i in range(50)]
+    for d in digs:
+        want = r.rank(d, sorted(members))
+        assert r.ranked(d, members) == want
+        assert r.ranked(d, list(reversed(members))) == want  # unsorted ok
+    r.note_membership(members)
+    for d in digs:                       # default membership view
+        assert r.ranked(d) == r.rank(d, sorted(members))
+
+
 def test_spill_policy_gauges():
     """Each spill signal trips independently: inflight slack, reported
     queue depth, free-HBM floor; an arbiter-less replica (hbm None)

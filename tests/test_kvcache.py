@@ -53,6 +53,34 @@ def test_host_store_budget_lru():
     assert store.headroom_bytes == store.budget_bytes
 
 
+def test_host_store_peek_no_lru_touch():
+    """``peek`` (the fabric's export read) returns a copy WITHOUT the
+    recency bump: a fetch storm on one entry must not pin it hot and
+    evict the owner's own working set, and peeks must not skew the
+    hit/miss ratios."""
+    item = np.arange(1024, dtype=np.float32)    # 4 KiB each
+    store = HostKVStore(3 * item.nbytes)
+    for k in "abc":
+        assert store.put(k, item + ord(k))
+    hits0, misses0 = store.hits, store.misses
+    for _ in range(5):                          # a peek storm on "a"
+        got = store.peek("a")
+        np.testing.assert_array_equal(got, item + ord("a"))
+        assert got is not item                  # a copy, never the view
+    assert store.peeks == 5
+    assert store.hits == hits0 and store.misses == misses0
+    assert store.peek("nope") is None           # miss: uncounted either way
+    assert store.peeks == 5
+    # "a" stayed coldest despite the storm: the next put evicts IT
+    assert store.put("d", item)
+    assert "a" not in store
+    assert all(k in store for k in "bcd")
+    # contrast: get DOES touch — "b" survives the next eviction
+    store.get("b")
+    assert store.put("e", item)
+    assert "c" not in store and "b" in store
+
+
 # -- swap roundtrip ----------------------------------------------------------
 
 def test_swap_out_in_roundtrip_bit_exact():

@@ -193,6 +193,21 @@ def test_gen_inference_pb2_schema_drift_and_roundtrip():
     assert pb.DebugResponse().snapshot_json == ""
     assert pb.DebugResponse().profile_dir == ""
 
+    # fleet KV fabric (tpulab.kvfabric): the FetchKV unary — digest in,
+    # PR 6 wire-format shipment out; NOT_FOUND is the honest-miss code
+    # (publish pending, evicted, unarmed), never an error
+    fq = pb.FetchKVRequest.FromString(pb.FetchKVRequest(
+        model_name="llm", digest=b"\x01" * 16).SerializeToString())
+    assert fq.model_name == "llm" and fq.digest == b"\x01" * 16
+    assert pb.FetchKVRequest().digest == b""
+    fr = pb.FetchKVResponse(kv_shipment=b"TPKV-blob")
+    fr.status.code = pb.NOT_FOUND
+    fr = pb.FetchKVResponse.FromString(fr.SerializeToString())
+    assert fr.kv_shipment == b"TPKV-blob"
+    assert fr.status.code == pb.NOT_FOUND
+    assert pb.NOT_FOUND == 7
+    assert pb.FetchKVResponse().kv_shipment == b""
+
 
 # -- capture policy (stubbed attempts; no device needed) ----------------------
 def _bc(monkeypatch, recs):

@@ -387,7 +387,7 @@ def test_metrics_inventory_documented_and_disjoint():
                   M.GenerationMetrics, M.AdmissionMetrics,
                   M.KVTierMetrics, M.ModelStoreMetrics, M.HBMMetrics,
                   M.ChaosMetrics, M.FleetMetrics, M.BatchMetrics,
-                  M.SLOMetrics, M.FederationMetrics)
+                  M.SLOMetrics, M.FederationMetrics, M.KVFabricMetrics)
     families = {}
     for cls in collectors:
         m = cls(registry=CollectorRegistry())

@@ -230,6 +230,25 @@ def build_file() -> dp.FileDescriptorProto:
     ])
     m.oneof_decl.add(name="_seed")
 
+    # fleet KV fabric (tpulab.kvfabric, docs/SERVING.md "Fleet KV
+    # fabric"): a routed-astray replica PULLS a finished prefill's KV
+    # from the digest's home replica instead of recomputing it.  The
+    # request names the content digest (full-prompt prompt_digest,
+    # tpulab/disagg/wire.py); the response carries the snapshot in the
+    # PR 6 wire form — the same bytes a disagg shipment uses — or an
+    # honest NOT_FOUND (bounded staleness: the owner never fabricates).
+    m = fd.message_type.add(name="FetchKVRequest")
+    m.field.extend([
+        field("model_name", 1, F.TYPE_STRING),
+        field("digest", 2, F.TYPE_BYTES),
+    ])
+    m = fd.message_type.add(name="FetchKVResponse")
+    m.field.extend([
+        field("status", 1, F.TYPE_MESSAGE, type_name="RequestStatus"),
+        # wire-form KV snapshot (empty on NOT_FOUND / degraded export)
+        field("kv_shipment", 2, F.TYPE_BYTES),
+    ])
+
     m = fd.message_type.add(name="GenerateResponse")
     m.field.extend([
         field("token", 1, F.TYPE_INT32),
@@ -251,7 +270,11 @@ def build_file() -> dp.FileDescriptorProto:
                       # admission-control fast-fail: the replica is
                       # overloaded, not broken — retry elsewhere/later
                       # (honor RequestStatus.retry_after_ms)
-                      ("RESOURCE_EXHAUSTED", 6)):
+                      ("RESOURCE_EXHAUSTED", 6),
+                      # FetchKV: the owner does not (or no longer) holds
+                      # the requested digest — an HONEST miss the fetcher
+                      # degrades from (local prefill), never a fault
+                      ("NOT_FOUND", 7)):
         e.value.add(name=name, number=num)
     return fd
 
@@ -366,6 +389,15 @@ def main() -> int:
         "r2 = pb.GenerateRequest();"
         "assert not r2.HasField('seed');"
         "r2.seed = 9; assert r2.HasField('seed');"
+        "fk = pb.FetchKVRequest(model_name='llm', digest=b'\\x01' * 16);"
+        "fk = pb.FetchKVRequest.FromString(fk.SerializeToString());"
+        "assert fk.model_name == 'llm' and fk.digest == b'\\x01' * 16;"
+        "fr = pb.FetchKVResponse(kv_shipment=b'wire');"
+        "fr.status.code = pb.NOT_FOUND;"
+        "fr = pb.FetchKVResponse.FromString(fr.SerializeToString());"
+        "assert fr.kv_shipment == b'wire';"
+        "assert fr.status.code == pb.NOT_FOUND == 7;"
+        "assert pb.FetchKVResponse().kv_shipment == b'';"
         "print('roundtrip OK')"
     )
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

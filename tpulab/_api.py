@@ -42,7 +42,7 @@ class InferenceManager(_EngineManager):
               model_hbm_budget: Optional[int] = None,
               model_host_budget: Optional[int] = None,
               pinned_models=(), hbm=None,
-              flight=None, fleet=None) -> "InferenceManager":
+              flight=None, fleet=None, kvfabric=None) -> "InferenceManager":
         """Expose registered models over the TRTIS-style gRPC service
         (reference manager.serve() -> BasicInferService).  ``batching=True``
         enables server-side dynamic batching across concurrent callers;
@@ -78,7 +78,12 @@ class InferenceManager(_EngineManager):
         ``flight=FlightRecorder()`` (tpulab.obs) arms per-request wide
         events with tail-based retention, and the ``Debug`` RPC serves
         the live engine snapshot + on-demand profiler captures
-        (docs/OBSERVABILITY.md "Flight recorder" / "Debugz")."""
+        (docs/OBSERVABILITY.md "Flight recorder" / "Debugz").
+
+        ``kvfabric=KVFabric(...)`` (tpulab.kvfabric) arms fleet-wide
+        prefix-KV pulls: a routed-astray request fetches its prefix KV
+        from the home replica over the ``FetchKV`` unary instead of
+        recomputing it (docs/SERVING.md "Fleet KV fabric")."""
         builders = {}
         if models:
             from tpulab.models.registry import build_model
@@ -114,7 +119,7 @@ class InferenceManager(_EngineManager):
             batch_window_s=batch_window_s, metrics=metrics, trace=trace,
             generation_engines=generation_engines, watchdog=watchdog,
             admission=admission, role=role, modelstore=modelstore,
-            hbm=hbm, flight=flight, fleet=fleet)
+            hbm=hbm, flight=flight, fleet=fleet, kvfabric=kvfabric)
         if wait:
             self._server.run()
         else:

@@ -75,6 +75,13 @@ Injection points currently planted (see docs/ROBUSTNESS.md):
                               error/drop lose that KV shipment: the decode
                               replica degrades to a local prefill, never a
                               corrupt lane or a stuck request
+    fabric.pull               fleet KV fabric (tpulab.kvfabric), tripped on
+                              BOTH sides of a cross-replica prefix fetch —
+                              owner-side export (error/drop make the owner
+                              answer an honest NOT_FOUND) and fetcher-side
+                              pull (error/drop abandon the fetch): either
+                              way the request degrades to a local prefill,
+                              never a corrupt or partial adoption
     modelstore.swap           WeightMultiplexer swap-out/swap-in
                               (tpulab.modelstore) — error/drop at swap-out
                               lose that model's weight snapshot (HBM still

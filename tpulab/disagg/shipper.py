@@ -134,6 +134,14 @@ class KVShipper:
         the lane setup) so it stops holding host-tier budget."""
         self.manager.discard(ship.handle)
 
+    def check_geometry(self, arr: np.ndarray, header: dict) -> None:
+        """Public face of the reject-don't-corrupt gate — every OTHER
+        path that admits foreign KV bytes into this pool (the fleet KV
+        fabric's pull, tpulab.kvfabric) must run the SAME validation as
+        a disagg import; re-deriving it per consumer is how one of them
+        silently corrupts a pool.  Raises :class:`WireFormatError`."""
+        self._check_geometry(arr, header)
+
     def _check_geometry(self, arr: np.ndarray, header: dict) -> None:
         """The reject-don't-corrupt gate: the shipment's layout must
         match the local pool axis for axis (page count excepted)."""

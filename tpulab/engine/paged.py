@@ -1008,6 +1008,29 @@ class PrefixCache:
         self.hits += n_shared
         self.misses += max(0, n_full_pages - n_shared)
 
+    def coverage(self, prompt, page_size: int) -> int:
+        """Cached-page count of ``prompt``'s full-page prefix WITHOUT the
+        lookup's side effects (no LRU touch, no ref bump, no host-tier
+        promotion) — the fleet KV fabric's local-hit probe
+        (tpulab.kvfabric): deciding whether a remote pull is worth it
+        must not perturb the cache it is measuring.  Advisory by nature:
+        the RPC thread calls it while the scheduler mutates entries, so
+        the answer can be one tick stale — staleness in either direction
+        only costs work (a skipped pull, a redundant one), never
+        correctness: the real ``lookup`` still runs at prefill."""
+        t = len(prompt)
+        cacheable = max(0, (t - 1) // page_size)
+        if cacheable == 0:
+            return 0
+        digests = self._digests(np.asarray(prompt, np.int32), page_size,
+                                cacheable)
+        n = 0
+        for d in digests:
+            if d not in self._entries:
+                break
+            n += 1
+        return n
+
     def insert(self, digests: List[bytes], pages: List[int]) -> None:
         """Publish a prefilled request's full prompt pages (one extra pool
         ref each, owned by the cache).  Digest collisions with existing
@@ -1339,7 +1362,8 @@ class ContinuousBatcher:
                  draft_n_kv_heads: Optional[int] = None,
                  spec_accept_floor: float = 0.35,
                  mesh=None, hbm=None, flight=None,
-                 ragged: Optional[bool] = None):
+                 ragged: Optional[bool] = None,
+                 kv_publish: bool = False):
         import jax
         import jax.numpy as jnp
 
@@ -1638,6 +1662,28 @@ class ContinuousBatcher:
         if self.kv_offload is not None and self.prefix_cache is not None:
             self.prefix_cache.on_evict = self._demote_prefix
             self.prefix_cache.promote_fn = self._promote_prefix
+        # fleet KV fabric publish (tpulab.kvfabric, docs/SERVING.md
+        # "Fleet KV fabric"): finished FIRST prefills export their
+        # prompt-only KV to the host tier under ("fab", content_digest) —
+        # the same write-behind swap_out preemption uses — plus the
+        # prefill's last-position logits row under ("fablog", digest), so
+        # a FetchKV RPC can serve both to the digest's routed-astray
+        # fetchers without evicting this replica's own copy.  Requires
+        # kv_offload (the host tier IS the export buffer).  Publishes
+        # ride the legacy prefill dispatch only: the ragged plan's mixed
+        # rounds never fetch a host-visible logits row (documented
+        # limitation; ROADMAP follow-up).
+        if kv_publish and self.kv_offload is None:
+            raise ValueError("kv_publish requires kv_offload")
+        self.kv_publish = bool(kv_publish)
+        from collections import OrderedDict as _OD
+        self._fab_handles: "Dict[bytes, Any]" = _OD()
+        self._fab_lock = threading.Lock()
+        self.kv_publishes = 0  # prompt snapshots exported to the fabric
+        #: rolling prefill throughput (tokens/s, EWMA) — the fabric's
+        #: cost gate weighs a remote fetch's wire time against simply
+        #: recomputing the prompt here (0.0 until the first prefill)
+        self.prefill_ewma_tok_s = 0.0
         if prefill_chunk is not None:
             if prefill_chunk < page_size:
                 raise ValueError("prefill_chunk must be >= page_size")
@@ -2881,7 +2927,74 @@ class ContinuousBatcher:
             # generated tokens unique to this request — not worth caching
             self.prefix_cache.count_lookup(len(shared), len(digests))
             self.prefix_cache.insert(digests, req.pages[:len(digests)])
+        dt = t_pf1 - t_pf0
+        if dt > 0:
+            # rolling prefill throughput — the fabric cost gate's
+            # recompute-time estimate (see kv_publish in __init__)
+            inst = t / dt
+            self.prefill_ewma_tok_s = (
+                inst if self.prefill_ewma_tok_s == 0.0
+                else 0.7 * self.prefill_ewma_tok_s + 0.3 * inst)
+        if self.kv_publish and not was_resumed and req.export_digest is None:
+            self._fab_publish(req, prompt, t, last_logits)
         return True
+
+    #: published fabric snapshots kept addressable (digest -> handle);
+    #: beyond this the oldest export is forgotten — its store entries
+    #: removed — so the fabric can never squat the whole host tier
+    FAB_PUBLISH_CAP = 32
+
+    def _fab_publish(self, req: _PagedRequest, prompt: np.ndarray, t: int,
+                     last_logits) -> None:
+        """Export a finished first prefill to the fleet KV fabric
+        (tpulab.kvfabric): the prompt's pages snapshot to the host tier
+        under ``("fab", digest)`` through the same write-behind swap_out
+        the preemption path uses (gather dispatched HERE, before any
+        decode write into the tail page, so dispatch ordering makes the
+        snapshot prompt-only), and the last-position logits row lands
+        beside it under ``("fablog", digest)`` so a fetcher picks the
+        first token under its OWN sampling seed.  Best-effort end to
+        end: a degraded swap, a budget-refused put or a mid-flight
+        eviction all surface as an honest FetchKV NOT_FOUND — never a
+        wrong answer."""
+        from tpulab.disagg.wire import prompt_digest
+        digest = prompt_digest(prompt)
+        with self._fab_lock:
+            if digest in self._fab_handles:
+                self._fab_handles.move_to_end(digest)
+                return
+        n_pages = (t + self.page_size - 1) // self.page_size
+        handle = self.kv_offload.swap_out(
+            req.pages[:n_pages], t, self.pool.kv, key=("fab", digest))
+        if handle is None:
+            return
+        if not self.kv_offload.store.put(
+                ("fablog", digest),
+                np.asarray(last_logits, np.float32).reshape(-1)):
+            self.kv_offload.discard(handle)
+            return
+        self.kv_publishes += 1
+        with self._fab_lock:
+            self._fab_handles[digest] = handle
+            self._fab_handles.move_to_end(digest)
+            while len(self._fab_handles) > self.FAB_PUBLISH_CAP:
+                old_dig, old_h = self._fab_handles.popitem(last=False)
+                self.kv_offload.discard(old_h)
+                self.kv_offload.store.remove(("fablog", old_dig))
+
+    def fab_handle(self, digest: bytes):
+        """The published fabric snapshot for ``digest`` (a resident or
+        still-in-flight :class:`~tpulab.kvcache.offload.SwapHandle`), or
+        None — the FetchKV server's lookup.  Thread-safe: the RPC thread
+        reads while the scheduler publishes/evicts.  A hit bumps the
+        publish-registry LRU (fabric-popular digests stay addressable)
+        WITHOUT touching the host store's own recency — the store read
+        goes through ``peek``."""
+        with self._fab_lock:
+            h = self._fab_handles.get(digest)
+            if h is not None:
+                self._fab_handles.move_to_end(digest)
+            return h
 
     def _try_swap_in(self, req: _PagedRequest, t: int,
                      lane: int) -> Optional[bool]:

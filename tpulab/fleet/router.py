@@ -121,6 +121,24 @@ class PrefixAffinityRouter:
                       key=lambda m: (self._score(digest, m), m),
                       reverse=True)
 
+    def ranked(self, digest: bytes,
+               members: Optional[Iterable[str]] = None) -> List[str]:
+        """The one public HRW ranking every consumer shares: replica-set
+        picks (`_pick_affine`/`_hedge_pick`), the disagg handoff's home
+        resolution and the fleet KV fabric (tpulab.kvfabric) all key off
+        THIS ordering — re-deriving it per call site risks the orderings
+        drifting apart, and then "the fabric's home" is not "the
+        router's home".
+
+        ``members`` defaults to the membership last recorded by
+        :meth:`note_membership`.  Member keys are canonicalized (sorted)
+        before scoring so callers need not pre-sort: identical member
+        SETS always produce the identical ranking."""
+        if members is None:
+            with self._lock:
+                members = self._members
+        return self.rank(digest, sorted(members))
+
     # -- membership / movement accounting -----------------------------------
     def note_membership(self, members: Iterable[str]) -> int:
         """Record the current ring membership; on a change, re-home the

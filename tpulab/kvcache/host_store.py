@@ -71,6 +71,7 @@ class HostKVStore:
         self.misses = 0        # get/pop did not
         self.evictions = 0     # LRU entries pushed out by budget pressure
         self.drops = 0         # payloads refused (larger than the budget)
+        self.peeks = 0         # non-LRU export reads (fabric fetches)
 
     # -- sizing --------------------------------------------------------------
     @property
@@ -131,6 +132,20 @@ class HostKVStore:
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
+            return e.desc.numpy(e.dtype, e.shape).copy()
+
+    def peek(self, key) -> Optional[np.ndarray]:
+        """A COPY of the payload WITHOUT the LRU touch — the fleet KV
+        fabric's export read (tpulab.kvfabric).  A remote replica pulling
+        a prefix must not look like local reuse: under a fetch storm,
+        ``get``'s recency bump would pin fabric-popular entries hot and
+        evict the owner's OWN working set instead.  Counted separately
+        (``peeks``) so fetch traffic never skews hit/miss ratios."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            self.peeks += 1
             return e.desc.numpy(e.dtype, e.shape).copy()
 
     def pop(self, key) -> Optional[np.ndarray]:

@@ -65,9 +65,15 @@ class InferResources(Resources):
                  generation_engines: Optional[Dict[str, object]] = None,
                  watchdog=None, trace=None, admission=None,
                  role: str = "unified", modelstore=None, hbm=None,
-                 flight=None, fleet=None):
+                 flight=None, fleet=None, kvfabric=None):
         self.manager = manager
         self.metrics = metrics
+        #: optional tpulab.kvfabric.KVFabric — fleet-wide prefix-KV pulls
+        #: (docs/SERVING.md "Fleet KV fabric"): a local prefix miss whose
+        #: digest homes elsewhere fetches the finished prefill from its
+        #: home replica instead of recomputing it.  None = fabric off
+        #: (one is-None branch per paged request).
+        self.kvfabric = kvfabric
         #: optional fleet control plane handle (anything with
         #: ``snapshot()``, normally tpulab.fleet.FleetController): a
         #: router-colocated replica reports election + supervision +
@@ -512,6 +518,45 @@ class DebugContext(Context):
         return resp
 
 
+class FetchKVContext(Context):
+    """Fleet KV fabric owner side (tpulab.kvfabric, docs/SERVING.md
+    "Fleet KV fabric"): serve one published prefill's wire-form KV
+    snapshot by content digest — WITHOUT consuming the local copy (the
+    export reads through the host tier's non-evicting ``peek``; this
+    replica's own prefix warmth is untouched by the fleet's fetch
+    traffic).  Misses — never published, publish still in write-behind
+    flight, evicted since — answer NOT_FOUND honestly rather than wait
+    out the owner's internal fences: bounded staleness is the contract,
+    and the fetcher's degrade path (a local prefill) is always correct."""
+
+    def execute_rpc(self, request: pb.FetchKVRequest) -> pb.FetchKVResponse:
+        res = self.get_resources(InferResources)
+        resp = pb.FetchKVResponse()
+        engines = res.generation_engines
+        name = request.model_name
+        if name:
+            engine = engines.get(name)
+            if engine is None:
+                resp.status.code = pb.UNKNOWN_MODEL
+                resp.status.message = f"no generation engine for {name!r}"
+                return resp
+        else:
+            engine = next(iter(engines.values()), None)
+        if engine is None or not getattr(engine, "kv_publish", False):
+            resp.status.code = pb.NOT_FOUND
+            resp.status.message = "fabric publish not armed"
+            return resp
+        from tpulab.kvfabric import fabric_export
+        blob = fabric_export(engine, bytes(request.digest))
+        if blob is None:
+            resp.status.code = pb.NOT_FOUND
+            resp.status.message = "digest not resident"
+        else:
+            resp.status.code = pb.SUCCESS
+            resp.kv_shipment = blob
+        return resp
+
+
 class StreamInferContext(StreamingContext):
     """Bidirectional pipelined inference (reference TRTIS StreamInfer /
     nvrpc streaming contexts): each incoming InferRequest dispatches
@@ -618,7 +663,8 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                         generation_engines: Optional[Dict[str, object]] = None,
                         watchdog=None, trace=None, admission=None,
                         role: str = "unified", modelstore=None,
-                        hbm=None, flight=None, fleet=None) -> Server:
+                        hbm=None, flight=None, fleet=None,
+                        kvfabric=None) -> Server:
     """Wire the inference service onto a Server
     (reference BasicInferService ctor infer.cc:644-678).
 
@@ -648,7 +694,12 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     (:class:`tpulab.fleet.FleetController` or anything with
     ``snapshot()``): the Debug snapshot then carries a ``fleet`` section
     — election, supervision and autoscaling state (docs/OBSERVABILITY.md
-    "Debugz")."""
+    "Debugz").  ``kvfabric`` is an optional
+    :class:`tpulab.kvfabric.KVFabric`: fleet-wide prefix-KV pulls
+    (docs/SERVING.md "Fleet KV fabric") — routed-astray paged requests
+    fetch their digest's finished prefill from its home replica over the
+    ``FetchKV`` RPC instead of recomputing it, and engines built with
+    ``kv_publish`` answer the fleet's fetches here."""
     if admission is not None and trace is not None \
             and getattr(admission, "trace", None) is None:
         # adopt the service's recorder: admission-decision spans land on
@@ -670,7 +721,8 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
                                generation_engines=generation_engines,
                                watchdog=watchdog, admission=admission,
                                role=role, modelstore=modelstore, hbm=hbm,
-                               flight=flight, fleet=fleet)
+                               flight=flight, fleet=fleet,
+                               kvfabric=kvfabric)
     server = Server(address, executor or Executor(n_threads=4))
     server._infer_resources = resources  # for shutdown
     service = AsyncService(SERVICE_NAME, resources)
@@ -686,6 +738,9 @@ def build_infer_service(manager, address: str = "0.0.0.0:0",
     service.register_rpc("Debug", DebugContext,
                          pb.DebugRequest.FromString,
                          pb.DebugResponse.SerializeToString)
+    service.register_rpc("FetchKV", FetchKVContext,
+                         pb.FetchKVRequest.FromString,
+                         pb.FetchKVResponse.SerializeToString)
     service.register_rpc("StreamInfer", StreamInferContext,
                          pb.InferRequest.FromString,
                          pb.InferResponse.SerializeToString)
@@ -1011,6 +1066,20 @@ class GenerateContext(StreamingContext):
             # only the REMAINING tokens decode sequentially
             cost = (len(request.prompt)
                     + max(1, request.steps - request.resume_length))
+        elif (res.kvfabric is not None
+              and not request.return_logprobs
+              and res.kvfabric.would_pull(
+                  np.asarray(request.prompt, np.int32),
+                  self._sampling_of(request),
+                  res.generation_engines.get(request.model_name),
+                  logprobs=request.return_logprobs) is not None):
+            # fabric-pullable arrival (tpulab.kvfabric): the prompt's KV
+            # will be fetched, not recomputed — charge the shipped-KV
+            # PROMOTE cost.  Undercharges when the pull later degrades
+            # to a local prefill, exactly like a shipped arrival whose
+            # import fails: admission costs are estimates, and the
+            # degrade path pays with latency, not with a second ticket.
+            cost = request.steps + max(1, len(request.prompt) // 16)
         else:
             cost = len(request.prompt) + request.steps
         try:
@@ -1350,6 +1419,46 @@ class GenerateContext(StreamingContext):
                         shipper.discard(ship)
                         log.warning("shipped-KV admit rejected, degrading "
                                     "to local prefill: %s", e)
+            if (fut is None and res.kvfabric is not None
+                    and not request.kv_shipment
+                    and not request.return_logprobs and not resume_ofs):
+                # fleet KV fabric (tpulab.kvfabric, docs/SERVING.md
+                # "Fleet KV fabric"): a routed-astray request whose
+                # digest homes on another replica PULLS the finished
+                # prefill from there and admits it through the same
+                # shipped-KV path — zero local prefill dispatches, bit-
+                # exact tokens.  pull() returning None (not eligible,
+                # cost-gated, single-flight timeout, chaos, NOT_FOUND,
+                # corrupt wire, budget refusal) means the plain submit
+                # below prefills locally: the fabric only ever SAVES
+                # work.
+                shipper = res.shipper_for(engine)
+                if shipper is not None:
+                    t_pull0 = _time.perf_counter()
+                    pulled = res.kvfabric.pull(
+                        np.asarray(request.prompt, np.int32), sampling,
+                        engine, shipper, model_name=request.model_name)
+                    if pulled is not None:
+                        try:
+                            fut = engine.submit_shipped(
+                                np.asarray(request.prompt, np.int32),
+                                request.steps, pulled.first_token,
+                                pulled.handle, on_token=on_token,
+                                sampling=sampling,
+                                priority=request.priority,
+                                stop_tokens=list(request.stop_tokens),
+                                **kw)
+                            self._fl_note(kv_pull={
+                                "bytes": pulled.nbytes,
+                                "tokens_saved": pulled.length,
+                                "coalesced": pulled.coalesced,
+                                "wait_s": round(
+                                    _time.perf_counter() - t_pull0, 6)})
+                        except ValueError as e:
+                            shipper.manager.discard(pulled.handle)
+                            res.kvfabric.note_degrade(pulled)
+                            log.warning("fabric-pull admit rejected, "
+                                        "degrading to local prefill: %s", e)
             if fut is None:
                 fut = engine.submit(np.asarray(request.prompt, np.int32),
                                     steps_eff, on_token=on_token,
@@ -1700,6 +1809,10 @@ class RemoteInferenceManager:
         self._debug = ClientUnary(
             self._executor, f"/{SERVICE_NAME}/Debug",
             pb.DebugRequest.SerializeToString, pb.DebugResponse.FromString)
+        self._fetch_kv = ClientUnary(
+            self._executor, f"/{SERVICE_NAME}/FetchKV",
+            pb.FetchKVRequest.SerializeToString,
+            pb.FetchKVResponse.FromString)
 
     def health(self, timeout: float = 10.0) -> pb.HealthResponse:
         """Liveness/readiness probe (reference TRTIS Health)."""
@@ -1741,6 +1854,27 @@ class RemoteInferenceManager:
 
     def health_async(self):
         return self._health.start(pb.HealthRequest())
+
+    def fetch_kv(self, model_name: str, digest: bytes,
+                 timeout: Optional[float] = 30.0) -> Optional[bytes]:
+        """Fleet KV fabric fetch (tpulab.kvfabric, docs/SERVING.md
+        "Fleet KV fabric"): the wire-form snapshot published for
+        ``digest`` on this replica, or None on an honest NOT_FOUND —
+        exactly the ``connect``-client surface
+        :class:`~tpulab.kvfabric.KVFabric` pulls through.  UNKNOWN_MODEL
+        and INTERNAL raise (a misconfigured fleet should be loud);
+        transport errors propagate for the fabric's degrade path to
+        absorb."""
+        resp = self._fetch_kv.start(pb.FetchKVRequest(
+            model_name=model_name,
+            digest=bytes(digest))).result(timeout=timeout)
+        if resp.status.code == pb.NOT_FOUND:
+            return None
+        if resp.status.code not in (pb.SUCCESS, 0):
+            raise RuntimeError(
+                f"FetchKV failed ({pb.StatusCode.Name(resp.status.code)}): "
+                f"{resp.status.message}")
+        return bytes(resp.kv_shipment) if resp.kv_shipment else None
 
     def get_models(self,
                    timeout: Optional[float] = None) -> Dict[str, pb.ModelStatus]:

@@ -1016,7 +1016,7 @@ class GenerationReplicaSet(_BaseReplicaSet):
             addr_of = {self.addresses[i]: i for i in self._ring_locked()}
         if not addr_of:
             return 0
-        return addr_of[self.router.rank(digest, sorted(addr_of))[0]]
+        return addr_of[self.router.ranked(digest, addr_of)[0]]
 
     def _pick_affine(self, prompt, exclude: frozenset,
                      allowed: Optional[frozenset] = None) -> Optional[int]:
@@ -1067,7 +1067,7 @@ class GenerationReplicaSet(_BaseReplicaSet):
             if ring:
                 addr_of = {self.addresses[i]: i for i in ring}
                 ranked = [addr_of[a] for a in
-                          self.router.rank(digest, sorted(addr_of))]
+                          self.router.ranked(digest, addr_of)]
                 eligible = [i for i in ranked if i not in exclude]
                 if eligible:
                     lo = min(self._inflight[i] for i in eligible)
@@ -1108,7 +1108,7 @@ class GenerationReplicaSet(_BaseReplicaSet):
                 if not ring:
                     return None
                 addr_of = {self.addresses[i]: i for i in ring}
-                idx = addr_of[self.router.rank(digest, sorted(addr_of))[0]]
+                idx = addr_of[self.router.ranked(digest, addr_of)[0]]
                 self._inflight[idx] += 1
                 self._note_inflight(idx)
                 return idx

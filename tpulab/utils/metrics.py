@@ -908,6 +908,77 @@ class KVTierMetrics:
         self.host_entries.set(len(store))
 
 
+class KVFabricMetrics:
+    """Fleet KV fabric telemetry (`_kvfabric_*`; tpulab.kvfabric): pull
+    counts/bytes and fetch-latency distribution, single-flight
+    coalesces, cost-gate skips, degrades and recompute-tokens-saved —
+    the view that says whether routed-astray requests are adopting the
+    fleet's warmth (pulls + tokens saved) or still recomputing it
+    (degrades), and whether the guard rails are earning their keep
+    (coalesces under fetch storms, cost-gate skips when the wire is
+    slower than the chip).  Latency/bytes are event-driven (pass this
+    object as the fabric's ``metrics=``); counters advance via
+    :meth:`poll`."""
+
+    def __init__(self, namespace: str = "tpulab",
+                 registry: Optional["CollectorRegistry"] = None):
+        if not HAVE_PROMETHEUS:  # pragma: no cover
+            raise RuntimeError("prometheus_client unavailable")
+        self.registry = registry or CollectorRegistry()
+        ns = namespace
+        self.pulls = Counter(
+            f"{ns}_kvfabric_pulls",
+            "Prefix-KV pulls fetched from a home replica and adopted "
+            "locally (each replaced a whole local prefill)",
+            registry=self.registry)
+        self.pull_bytes = Counter(
+            f"{ns}_kvfabric_pull_bytes",
+            "Wire bytes fetched over FetchKV", registry=self.registry)
+        self.pull_seconds = Histogram(
+            f"{ns}_kvfabric_pull_seconds",
+            "FetchKV fetch latency (RPC start -> snapshot decoded and "
+            "geometry-validated)", buckets=SWAP_BUCKETS,
+            registry=self.registry)
+        self.coalesced = Counter(
+            f"{ns}_kvfabric_coalesced",
+            "Concurrent same-digest misses served by another thread's "
+            "in-flight fetch (single-flight)", registry=self.registry)
+        self.cost_gate_skips = Counter(
+            f"{ns}_kvfabric_cost_gate_skips",
+            "Pulls skipped because the fetch-time estimate exceeded the "
+            "local recompute estimate", registry=self.registry)
+        self.degrades = Counter(
+            f"{ns}_kvfabric_degrades",
+            "Pull attempts degraded to a local prefill (NOT_FOUND, "
+            "chaos, transport, corrupt wire, budget refusal, admission "
+            "rejection)", registry=self.registry)
+        self.recompute_tokens_saved = Counter(
+            f"{ns}_kvfabric_recompute_tokens_saved",
+            "Prefill tokens pulls did NOT recompute (the fabric's work "
+            "saved, in tokens)", registry=self.registry)
+        self._last: Dict[str, int] = {}
+
+    # -- event hooks (called by KVFabric) ------------------------------------
+    def observe_pull(self, seconds: float, nbytes: int) -> None:
+        self.pull_seconds.observe(max(0.0, seconds))
+
+    def _advance(self, counter, key: str, value: int) -> None:
+        delta = value - self._last.get(key, 0)
+        if delta > 0:
+            counter.inc(delta)
+        self._last[key] = value
+
+    def poll(self, fabric) -> None:
+        """Sample a KVFabric (control-loop / poller hook)."""
+        self._advance(self.pulls, "p", fabric.pulls)
+        self._advance(self.pull_bytes, "pb", fabric.pull_bytes)
+        self._advance(self.coalesced, "co", fabric.coalesced)
+        self._advance(self.cost_gate_skips, "cg", fabric.cost_gate_skips)
+        self._advance(self.degrades, "dg", fabric.degrades)
+        self._advance(self.recompute_tokens_saved, "sv",
+                      fabric.recompute_tokens_saved)
+
+
 class ModelStoreMetrics:
     """Multi-model weight-tier telemetry (`_modelstore_*`;
     tpulab.modelstore): resident-vs-host-tier model gauges, weight swap
